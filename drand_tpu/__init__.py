@@ -1,0 +1,10 @@
+"""drand_tpu: a TPU-native distributed randomness beacon framework.
+
+A ground-up rebuild of the capabilities of drand (threshold-BLS randomness
+beacon, reference at /root/reference) with the BLS12-381 hot path — pairings,
+partial-signature batch verification, Lagrange-interpolation MSM, chain
+batch-verification — executed on TPU via JAX (jit/vmap/pjit, Pallas kernels),
+and the protocol plane (DKG, beacon rounds, gRPC mesh, CLI) on the host.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""BLS12-381 crypto: pure-Python oracle (refimpl) + JAX/TPU execution path."""

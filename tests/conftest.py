@@ -1,0 +1,16 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Real-TPU execution is exercised by bench.py / __graft_entry__.py; the test
+suite must run hermetically on CPU with 8 virtual devices so that the
+multi-chip sharding paths (pjit/shard_map over a Mesh) are covered without
+hardware (mirrors the driver's dryrun_multichip harness).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

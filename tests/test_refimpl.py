@@ -1,0 +1,183 @@
+"""Self-verifying tests for the pure-Python BLS12-381 oracle.
+
+No external vectors exist in this environment, so correctness is established
+mathematically: primality, BLS polynomial identities, curve/subgroup
+membership, field axioms on random elements, pairing bilinearity and
+non-degeneracy. Together these uniquely pin down the scheme.
+"""
+
+import random
+
+import pytest
+
+from drand_tpu.crypto import refimpl as ref
+
+rng = random.Random(0xBEEF)
+
+
+def rand_fp():
+    return rng.randrange(ref.P)
+
+
+def rand_fp2():
+    return (rand_fp(), rand_fp())
+
+
+def rand_fp12():
+    return (
+        (rand_fp2(), rand_fp2(), rand_fp2()),
+        (rand_fp2(), rand_fp2(), rand_fp2()),
+    )
+
+
+def test_selfcheck_constants():
+    ref.selfcheck()
+
+
+def test_fp2_field_axioms():
+    for _ in range(20):
+        a, b, c = rand_fp2(), rand_fp2(), rand_fp2()
+        assert ref.fp2_mul(a, ref.fp2_mul(b, c)) == ref.fp2_mul(
+            ref.fp2_mul(a, b), c
+        )
+        assert ref.fp2_mul(a, ref.fp2_add(b, c)) == ref.fp2_add(
+            ref.fp2_mul(a, b), ref.fp2_mul(a, c)
+        )
+        assert ref.fp2_sqr(a) == ref.fp2_mul(a, a)
+        if a != ref.FP2_ZERO:
+            assert ref.fp2_mul(a, ref.fp2_inv(a)) == ref.FP2_ONE
+
+
+def test_fp6_fp12_inverses_and_assoc():
+    for _ in range(5):
+        a, b = rand_fp12(), rand_fp12()
+        ab = ref.fp12_mul(a, b)
+        assert ref.fp12_mul(ab, ref.fp12_inv(b)) == a
+        assert ref.fp12_sqr(a) == ref.fp12_mul(a, a)
+    for _ in range(5):
+        a6 = (rand_fp2(), rand_fp2(), rand_fp2())
+        assert ref.fp6_mul(a6, ref.fp6_inv(a6)) == ref.FP6_ONE
+
+
+def test_frobenius_p2_matches_pow():
+    a = rand_fp12()
+    assert ref.fp12_frob2(a) == ref.fp12_pow(a, ref.P * ref.P)
+
+
+def test_conjugate_is_p6_frobenius():
+    a = rand_fp12()
+    assert ref.fp12_conj(a) == ref.fp12_pow(a, ref.P**6)
+
+
+def test_fp2_sqrt_roundtrip():
+    for _ in range(10):
+        a = rand_fp2()
+        sq = ref.fp2_sqr(a)
+        s = ref.fp2_sqrt(sq)
+        assert s is not None
+        assert ref.fp2_sqr(s) == sq
+        assert ref.fp2_is_square(sq)
+
+
+def test_curve_group_laws():
+    g = ref.G1_GEN
+    h = ref.G2_GEN
+    # scalar-mult distributivity over random scalars
+    a, b = rng.randrange(ref.R), rng.randrange(ref.R)
+    assert ref.g1_add(ref.g1_mul(g, a), ref.g1_mul(g, b)) == ref.g1_mul(
+        g, (a + b) % ref.R
+    )
+    assert ref.g2_add(ref.g2_mul(h, a), ref.g2_mul(h, b)) == ref.g2_mul(
+        h, (a + b) % ref.R
+    )
+    # identity / inverse
+    assert ref.g1_add(g, ref.g1_neg(g)) is None
+    assert ref.g2_add(h, ref.g2_neg(h)) is None
+    assert ref.g1_is_on_curve(ref.g1_mul(g, a))
+    assert ref.g2_is_on_curve(ref.g2_mul(h, a))
+
+
+def test_pairing_bilinearity_and_nondegeneracy():
+    e_gh = ref.pairing(ref.G1_GEN, ref.G2_GEN)
+    assert e_gh != ref.FP12_ONE, "pairing must be non-degenerate"
+    # e(g,h)^r == 1 (image lies in the r-torsion of GT)
+    assert ref.fp12_pow(e_gh, ref.R) == ref.FP12_ONE
+    a, b = rng.randrange(1, 2**64), rng.randrange(1, 2**64)
+    lhs = ref.pairing(ref.g1_mul(ref.G1_GEN, a), ref.g2_mul(ref.G2_GEN, b))
+    rhs = ref.fp12_pow(e_gh, a * b % ref.R)
+    assert lhs == rhs, "bilinearity e(aP,bQ) = e(P,Q)^{ab}"
+
+
+def test_multi_pairing_product():
+    a = rng.randrange(1, 2**32)
+    p1 = ref.g1_mul(ref.G1_GEN, a)
+    q = ref.G2_GEN
+    # e(aP, Q) * e(-aP, Q) == 1
+    acc = ref.multi_pairing([(p1, q), (ref.g1_neg(p1), q)])
+    assert acc == ref.FP12_ONE
+
+
+def test_hash_to_g2_valid_and_deterministic():
+    seen = set()
+    for msg in [b"", b"drand", b"round-1", bytes(range(100))]:
+        pt = ref.hash_to_g2(msg)
+        assert pt is not None
+        assert ref.g2_is_on_curve(pt)
+        assert ref.g2_mul(pt, ref.R) is None, "must be in r-torsion"
+        assert ref.hash_to_g2(msg) == pt
+        seen.add(pt)
+    assert len(seen) == 4
+
+
+def test_hash_to_g1_valid():
+    pt = ref.hash_to_g1(b"hello")
+    assert ref.g1_is_on_curve(pt)
+    assert ref.g1_mul(pt, ref.R) is None
+
+
+def test_svdw_map_edge_cases():
+    # u = 0 and a spread of random u must all land on-curve, no exceptions.
+    for u in [0, 1, ref.P - 1] + [rand_fp() for _ in range(30)]:
+        x, y = ref.SVDW_G1.map_to_curve(u)
+        assert (y * y - (x * x * x + ref.B1)) % ref.P == 0
+    for u2 in [(0, 0), (1, 0), (0, 1)] + [rand_fp2() for _ in range(30)]:
+        pt = ref.SVDW_G2.map_to_curve(u2)
+        assert ref.g2_is_on_curve(pt)
+
+
+def test_serialization_roundtrip():
+    for _ in range(5):
+        k = rng.randrange(1, ref.R)
+        p1 = ref.g1_mul(ref.G1_GEN, k)
+        assert ref.g1_from_bytes(ref.g1_to_bytes(p1)) == p1
+        p2 = ref.g2_mul(ref.G2_GEN, k)
+        assert ref.g2_from_bytes(ref.g2_to_bytes(p2)) == p2
+    assert ref.g1_from_bytes(ref.g1_to_bytes(None)) is None
+    assert ref.g2_from_bytes(ref.g2_to_bytes(None)) is None
+    assert len(ref.g1_to_bytes(ref.G1_GEN)) == 48
+    assert len(ref.g2_to_bytes(ref.G2_GEN)) == 96
+
+
+def test_serialization_rejects_bad_points():
+    with pytest.raises(ValueError):
+        ref.g1_from_bytes(bytes(48))  # compression flag missing
+    # a point on curve but (overwhelmingly likely) not in the subgroup:
+    x0 = 3
+    while True:
+        y = ref.fp_sqrt((x0**3 + ref.B1) % ref.P)
+        if y is not None and ref.g1_mul((x0, y), ref.R) is not None:
+            break
+        x0 += 1
+    bad = bytearray((x0).to_bytes(48, "big"))
+    bad[0] |= 0x80
+    if y > (ref.P - 1) // 2:
+        bad[0] |= 0x20
+    with pytest.raises(ValueError):
+        ref.g1_from_bytes(bytes(bad))
+
+
+def test_expand_message_xmd_shapes():
+    out = ref.expand_message_xmd(b"abc", b"DST", 128)
+    assert len(out) == 128
+    assert out != ref.expand_message_xmd(b"abd", b"DST", 128)
+    assert out[:32] != bytes(32)
