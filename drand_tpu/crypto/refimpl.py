@@ -872,7 +872,7 @@ def g2_from_bytes(data: bytes, subgroup_check: bool = True):
     if not flags & _FLAG_COMPRESSED:
         raise ValueError("only compressed encoding supported")
     if flags & _FLAG_INFINITY:
-        if any(data[1:]):
+        if any(data[1:]) or flags & ~(_FLAG_COMPRESSED | _FLAG_INFINITY):
             raise ValueError("malformed infinity encoding")
         return None
     x1 = int.from_bytes(bytes([flags & 0x1F]) + data[1:48], "big")

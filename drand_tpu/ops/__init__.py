@@ -1,0 +1,18 @@
+"""TPU-native BLS12-381 kernels (JAX / XLA / Pallas).
+
+This package replaces the reference's external crypto hot path
+(`github.com/drand/bls12-381` + `github.com/drand/kyber`, selected at
+/root/reference/key/curve.go:12-30) with batched, fixed-shape JAX
+computations suitable for the MXU/VPU:
+
+- :mod:`drand_tpu.ops.fp`      — base field Fp as 34x12-bit int32 limb vectors
+                                  (Montgomery arithmetic, lazy carries)
+- :mod:`drand_tpu.ops.tower`   — Fp2 / Fp6 / Fp12 extension tower + Frobenius
+- :mod:`drand_tpu.ops.curve`   — G1/G2 complete projective point arithmetic
+- :mod:`drand_tpu.ops.pairing` — optimal-ate Miller loop + final exponentiation
+- :mod:`drand_tpu.ops.msm`     — multi-scalar multiplication (Lagrange recovery)
+
+Everything is jit/vmap-compatible with static shapes: scalar loops are
+`lax.scan` / unrolled constant-trip loops, carries are fixed-pass parallel
+sweeps, there is no data-dependent control flow.
+"""

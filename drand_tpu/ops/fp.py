@@ -1,0 +1,366 @@
+"""Base field Fp of BLS12-381 as fixed-shape int32 limb vectors (JAX).
+
+Role in the framework: this is the lowest layer of the TPU crypto path,
+replacing the Go-assembly field arithmetic of ``github.com/drand/bls12-381``
+(the reference's hot-path dependency, /root/reference/go.mod:9) with
+MXU/VPU-friendly batched integer arithmetic.
+
+Representation
+--------------
+A field element is a vector of ``NLIMB = 34`` limbs in base ``B = 2^12``
+stored as ``int32`` (shape ``(..., 34)``, little-endian limb order), giving
+408 bits of headroom over the 381-bit modulus.  Why 12-bit limbs in int32:
+
+* limb products fit comfortably: a full 34-term column sum is bounded by
+  ``34 * (2^12)^2 = 2^29.1 < 2^31`` — no 64-bit integers anywhere, which
+  matters because TPUs have no native int64.
+* carries are *lazy*: after a convolution we run a fixed number (3) of
+  data-independent parallel carry sweeps, which provably bring every limb
+  back to ``<= 2^12`` (see ``_carry``).  No data-dependent control flow.
+
+Values are kept in **Montgomery form** (``x_stored = x * R mod p`` up to
+multiples of p, with ``R = 2^408``) and are only *loosely* reduced: stored
+integer values may exceed ``p`` (they stay far below ``2^399``, see the
+bound notes inside ``mont_mul``/``sub``).  Exact canonical reduction happens
+only at comparison/serialization boundaries (``canon``).
+
+All public ops return limbs ``<= 2^12`` (limb 0 may be ``2^12 + 1``) and are
+jit/vmap-compatible with static shapes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from drand_tpu.crypto.refimpl import P
+
+# --------------------------------------------------------------------------
+# Limb geometry.
+# --------------------------------------------------------------------------
+
+BITS = 12
+BASE = 1 << BITS
+MASK = BASE - 1
+NLIMB = 34                    # 34 * 12 = 408 bits
+NWIDE = 2 * NLIMB + 1         # product + carry slack
+R_MONT = 1 << (BITS * NLIMB)  # Montgomery radix R = 2^408
+
+DTYPE = jnp.int32
+
+
+def int_to_limbs(x: int, n: int = NLIMB) -> np.ndarray:
+    """Encode a non-negative python int as n little-endian base-2^12 limbs."""
+    assert 0 <= x < (1 << (BITS * n)), "value does not fit"
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = x & MASK
+        x >>= BITS
+    return out
+
+
+def limbs_to_int(a) -> int:
+    """Decode limbs (any non-negative int32 values) back to a python int."""
+    arr = np.asarray(a)
+    assert arr.ndim == 1
+    return sum(int(v) << (BITS * i) for i, v in enumerate(arr.tolist()))
+
+
+# --------------------------------------------------------------------------
+# Precomputed constants (python ints at import time; tiny).
+# --------------------------------------------------------------------------
+
+#: -p^-1 mod R, for Montgomery REDC.
+NP_INT = (-pow(P, -1, R_MONT)) % R_MONT
+#: R^2 mod p, for conversion into Montgomery form.
+RR_INT = (R_MONT * R_MONT) % P
+
+
+def _make_sub_offset() -> np.ndarray:
+    """A multiple of p that makes subtraction branchless.
+
+    ``a - b + M`` must be limb-wise non-negative for every normalized
+    ``b``: limbs 0..31 up to ``B+1``, limb 32 up to a few units (values
+    stay < 2^386, see the invariant notes), limb 33 zero.  So M has limbs
+    ``0x1800 + d_i`` in positions 0..31 and ``0x40`` in position 32, with
+    the digits d of ``ceil(S/p)*p - S`` absorbing the round-up to a
+    multiple of p.  Value ~2^390 — small enough that three top-limb folds
+    bring any sub/neg output back under the 2^386 invariant.
+    """
+    s = sum(0x1800 << (BITS * i) for i in range(32)) + (0x40 << (BITS * 32))
+    k = -(-s // P)  # ceil
+    d = k * P - s   # in [0, p) < 2^384, so digits vanish above limb 31
+    assert 0 <= d < P
+    m = int_to_limbs(d)
+    m[:32] += 0x1800
+    m[32] += 0x40
+    assert m[:32].min() >= 0x1800 and m[:32].max() < 0x2800
+    assert limbs_to_int(m) % P == 0
+    return m.astype(np.int32)
+
+
+P_LIMBS = int_to_limbs(P)
+NP_LIMBS = int_to_limbs(NP_INT)
+RR_LIMBS = int_to_limbs(RR_INT)
+ONE_MONT = int_to_limbs(R_MONT % P)      # Montgomery form of 1
+ONE_PLAIN = int_to_limbs(1)
+ZERO = np.zeros(NLIMB, dtype=np.int32)
+M_SUB = _make_sub_offset()
+#: 2^(12*32) mod p and 2^(12*33) mod p — for folding limbs 32/33 back down.
+REDHI0 = int_to_limbs((1 << (BITS * 32)) % P)
+REDHI1 = int_to_limbs((1 << (BITS * 33)) % P)
+
+
+# --------------------------------------------------------------------------
+# Carries and convolution.
+# --------------------------------------------------------------------------
+
+
+def _carry(x: jnp.ndarray, out_len: int, passes: int = 3,
+           drop_overflow: bool = False) -> jnp.ndarray:
+    """Fixed-pass parallel carry normalization (non-negative limbs).
+
+    Bound argument: one pass maps max limb value M to ``(B-1) + M/B``.
+    Starting from column sums ``< 2^30``, three passes give
+    ``<= (B-1) + 2^18 -> <= (B-1) + 2^6.2 -> <= B`` — a stable invariant
+    (limbs may equal exactly ``B``; that is accounted for everywhere).
+
+    ``out_len`` must be large enough that the true value fits, so the top
+    limb never overflows (unless ``drop_overflow``, which implements
+    reduction mod ``B^out_len`` — i.e. mod R when out_len == NLIMB).
+    """
+    n = x.shape[-1]
+    if n < out_len:
+        pad = [(0, 0)] * (x.ndim - 1) + [(0, out_len - n)]
+        x = jnp.pad(x, pad)
+    elif n > out_len:
+        raise ValueError("carry cannot shrink the limb vector")
+    for _ in range(passes):
+        hi = x >> BITS
+        lo = x & MASK
+        shifted = jnp.concatenate(
+            [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
+        )
+        x = lo + shifted
+        if not drop_overflow:
+            # keep the top limb's overflow in place so the value is
+            # preserved even if a caller undersizes out_len (correct
+            # sizing still yields limbs <= B everywhere)
+            x = x.at[..., -1].add(hi[..., -1] << BITS)
+    return x
+
+
+def _fold_top(x: jnp.ndarray, folds: int = 1) -> jnp.ndarray:
+    """Reduce limbs 32/33 back into the low limbs via 2^(12k) mod p.
+
+    Each fold maps value v to < 2^384 + (v/2^384)*p, i.e. shrinks the
+    overflow above 2^384 by a factor p/2^384 ~ 2^-2.7.  Callers pick the
+    fold count so outputs satisfy the global invariant value < 2^386.
+    Input limbs must be non-negative and <= B (carried).
+    """
+    nz = NLIMB - 32
+    for _ in range(folds):
+        lo = jnp.concatenate(
+            [x[..., :32], jnp.zeros_like(x[..., :nz])], axis=-1
+        )
+        t = (
+            lo
+            + x[..., 32:33] * jnp.asarray(REDHI0)
+            + x[..., 33:34] * jnp.asarray(REDHI1)
+        )
+        x = _carry(t, NLIMB, passes=2)
+    return x
+
+
+def _conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Full schoolbook product: (..., na) x (..., nb) -> (..., na+nb-1).
+
+    Written as nb shifted multiply-accumulates so XLA sees a static chain
+    of fused vector ops (batch-friendly; no gathers).
+    """
+    na = a.shape[-1]
+    nb = b.shape[-1]
+    width = na + nb - 1
+    out = None
+    for j in range(nb):
+        term = a * b[..., j : j + 1]
+        pad = [(0, 0)] * (a.ndim - 1) + [(j, width - na - j)]
+        term = jnp.pad(term, pad)
+        out = term if out is None else out + term
+    return out
+
+
+# --------------------------------------------------------------------------
+# Montgomery arithmetic.
+# --------------------------------------------------------------------------
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """REDC(a*b): Montgomery product of two loosely-reduced elements.
+
+    Inputs: limbs arbitrary non-negative (carried internally), values
+    ``< 2^399``.  Output: limbs ``<= B`` (limb 0 up to ``B+1``), value
+    ``< max(p(1+2^-12), V^2/R + p) + 1`` — comfortably ``< 2^392`` for all
+    call patterns in the tower, so the representation is self-stabilizing.
+    """
+    a = _carry(a, NLIMB)
+    b = _carry(b, NLIMB)
+    t = _conv(a, b)                       # 67 cols, each < 2^29.2
+    t = _carry(t, NWIDE)                  # 69 limbs <= B, value = a*b
+    # m = (t * (-p^-1)) mod R  — only the low NLIMB columns matter
+    m = _conv(t[..., :NLIMB], jnp.asarray(NP_LIMBS))[..., :NLIMB]
+    m = _carry(m, NLIMB, drop_overflow=True)
+    # s = t + m*p  ==  0 (mod R)
+    mp = _conv(m, jnp.asarray(P_LIMBS))   # 67 cols
+    pad = [(0, 0)] * (mp.ndim - 1) + [(0, NWIDE - mp.shape[-1])]
+    s = t + jnp.pad(mp, pad)
+    s = _carry(s, NWIDE)
+    # Exact division by R: the low part's value is == 0 (mod R) and
+    # < 2R, hence it is exactly 0 or exactly R -> carry bit is any(!=0).
+    c = jnp.any(s[..., :NLIMB] != 0, axis=-1).astype(DTYPE)
+    out = s[..., NLIMB : 2 * NLIMB]
+    out = out.at[..., 0].add(c)
+    return out
+
+
+def mont_sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mont_mul(a, a)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field addition (lazy: limb add, carry sweep, one top fold)."""
+    return _fold_top(_carry(a + b, NLIMB, passes=2), folds=1)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field subtraction: a - b + M where M = 0 mod p keeps limbs >= 0.
+
+    Requires b normalized (every public-op output is): limbs <= B+1,
+    value < 2^386.  Output is normalized again after three top folds.
+    """
+    return _fold_top(
+        _carry(a - b + jnp.asarray(M_SUB), NLIMB, passes=2), folds=3
+    )
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _fold_top(
+        _carry(jnp.asarray(M_SUB) - a, NLIMB, passes=2), folds=3
+    )
+
+
+def muls(a: jnp.ndarray, s: int) -> jnp.ndarray:
+    """Multiply by a small static non-negative int (s <= 64)."""
+    assert 0 <= s <= 64
+    return _fold_top(_carry(a * s, NLIMB, passes=3), folds=3)
+
+
+def zero(shape=()) -> jnp.ndarray:
+    return jnp.zeros((*shape, NLIMB), dtype=DTYPE)
+
+
+def one_mont(shape=()) -> jnp.ndarray:
+    return jnp.broadcast_to(jnp.asarray(ONE_MONT), (*shape, NLIMB))
+
+
+def to_mont(a: jnp.ndarray) -> jnp.ndarray:
+    """Plain-integer limbs -> Montgomery form."""
+    return mont_mul(a, jnp.asarray(RR_LIMBS))
+
+
+def from_mont(a: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery form -> plain value, loosely reduced (< p + 2^371)."""
+    return mont_mul(a, jnp.asarray(ONE_PLAIN))
+
+
+# --------------------------------------------------------------------------
+# Exact reduction / comparison (boundary ops; uses one short scan).
+# --------------------------------------------------------------------------
+
+
+def _exact_carry_signed(x: jnp.ndarray):
+    """Exact sequential carry/borrow propagation over the last axis.
+
+    Returns (limbs in [0, B), final carry).  The final carry is negative
+    iff the represented value is negative.  O(NLIMB) scan — used only at
+    canonicalization boundaries, never in the mul hot path.
+    """
+    xm = jnp.moveaxis(x, -1, 0)
+
+    def step(c, xi):
+        t = xi + c
+        return t >> BITS, t & MASK
+
+    c0 = jnp.zeros(x.shape[:-1], dtype=DTYPE)
+    cf, ys = lax.scan(step, c0, xm)
+    return jnp.moveaxis(ys, 0, -1), cf
+
+
+def canon(a: jnp.ndarray) -> jnp.ndarray:
+    """Exact canonical plain-form limbs in [0, p) from a Montgomery input.
+
+    from_mont output is < p + 2^371 < 2p, so a single exact conditional
+    subtraction of p suffices.
+    """
+    v = from_mont(a)
+    d, borrow = _exact_carry_signed(v - jnp.asarray(P_LIMBS))
+    vx, _ = _exact_carry_signed(v)
+    keep = (borrow < 0)[..., None]
+    return jnp.where(keep, vx, d)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Exact field equality of two Montgomery-form elements -> bool (...)."""
+    return jnp.all(canon(a) == canon(b), axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canon(a) == 0, axis=-1)
+
+
+# --------------------------------------------------------------------------
+# Exponentiation by static exponents (scan over bits).
+# --------------------------------------------------------------------------
+
+
+def mont_pow(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e for a static python-int exponent, MSB-first square-and-multiply.
+
+    The bit pattern is a compile-time constant array scanned by lax.scan:
+    constant trip count, no data-dependent branching.
+    """
+    assert e >= 0
+    if e == 0:
+        return one_mont(a.shape[:-1])
+    bits = np.array([int(c) for c in bin(e)[2:]], dtype=np.int32)
+
+    def step(acc, bit):
+        acc = mont_sqr(acc)
+        acc = jnp.where(bit != 0, mont_mul(acc, a), acc)
+        return acc, None
+
+    acc0 = one_mont(a.shape[:-1])
+    out, _ = lax.scan(step, acc0, jnp.asarray(bits))
+    return out
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery-domain inverse via Fermat: a^(p-2). inv(0) = 0."""
+    return mont_pow(a, P - 2)
+
+
+# --------------------------------------------------------------------------
+# Host-side helpers (tests / IO).
+# --------------------------------------------------------------------------
+
+
+def fp_encode(x: int) -> jnp.ndarray:
+    """Python int (mod p) -> Montgomery limbs on device."""
+    return to_mont(jnp.asarray(int_to_limbs(x % P)))
+
+
+def fp_decode(a) -> int:
+    """Montgomery limbs -> canonical python int (canon guarantees < p)."""
+    return limbs_to_int(np.asarray(canon(a)))

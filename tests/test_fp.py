@@ -1,0 +1,104 @@
+"""Fp limb arithmetic vs the pure-Python oracle (drand_tpu.crypto.refimpl)."""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from drand_tpu.crypto.refimpl import P
+from drand_tpu.ops import fp
+
+rng = random.Random(0xF1E1D)
+
+
+def rand_ints(n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def batch_encode(xs):
+    return fp.to_mont(jnp.asarray(np.stack([fp.int_to_limbs(x) for x in xs])))
+
+
+def batch_decode(a):
+    c = np.asarray(fp.canon(a))
+    vals = [fp.limbs_to_int(row) for row in c]
+    assert all(0 <= v < P for v in vals), "canon must be canonical"
+    return vals
+
+
+def test_codec_roundtrip():
+    xs = rand_ints(8) + [0, 1, P - 1]
+    enc = batch_encode(xs)
+    assert batch_decode(enc) == [x % P for x in xs]
+
+
+def test_limb_bounds_invariant():
+    xs, ys = rand_ints(16), rand_ints(16)
+    a, b = batch_encode(xs), batch_encode(ys)
+    for op in (fp.mont_mul(a, b), fp.add(a, b), fp.sub(a, b), fp.neg(a),
+               fp.muls(a, 13)):
+        arr = np.asarray(op)
+        assert arr.min() >= 0
+        assert arr[..., 1:].max() <= fp.BASE
+        assert arr[..., 0].max() <= fp.BASE + 1
+
+
+def test_mul_add_sub_vs_oracle():
+    xs, ys = rand_ints(32), rand_ints(32)
+    a, b = batch_encode(xs), batch_encode(ys)
+    assert batch_decode(fp.mont_mul(a, b)) == [x * y % P for x, y in zip(xs, ys)]
+    assert batch_decode(fp.add(a, b)) == [(x + y) % P for x, y in zip(xs, ys)]
+    assert batch_decode(fp.sub(a, b)) == [(x - y) % P for x, y in zip(xs, ys)]
+    assert batch_decode(fp.neg(a)) == [(-x) % P for x in xs]
+    assert batch_decode(fp.muls(a, 9)) == [x * 9 % P for x in xs]
+
+
+def test_deep_lazy_chains_stay_correct():
+    # pile up adds/subs/muls without intermediate canonicalization
+    xs, ys = rand_ints(8), rand_ints(8)
+    a, b = batch_encode(xs), batch_encode(ys)
+    got = a
+    want = list(xs)
+    for i in range(20):
+        got = fp.add(fp.mont_mul(got, b), fp.sub(got, fp.muls(b, 3)))
+        want = [(w * y + (w - 3 * y)) % P for w, y in zip(want, ys)]
+    assert batch_decode(got) == want
+
+
+def test_pow_and_inv():
+    xs = rand_ints(4)
+    a = batch_encode(xs)
+    e = 0xDEADBEEFCAFE
+    assert batch_decode(fp.mont_pow(a, e)) == [pow(x, e, P) for x in xs]
+    ai = fp.inv(a)
+    assert batch_decode(fp.mont_mul(a, ai)) == [1] * 4
+
+
+def test_eq_and_zero():
+    xs = rand_ints(4)
+    a = batch_encode(xs)
+    b = batch_encode([(x + P) % P for x in xs])  # same values
+    assert bool(jnp.all(fp.eq(a, b)))
+    z = batch_encode([0, 1, 0, 5])
+    assert np.asarray(fp.is_zero(z)).tolist() == [True, False, True, False]
+
+
+def test_jit_and_vmap():
+    f = jax.jit(lambda a, b: fp.mont_mul(fp.add(a, b), fp.sub(a, b)))
+    xs, ys = rand_ints(8), rand_ints(8)
+    a, b = batch_encode(xs), batch_encode(ys)
+    got = batch_decode(f(a, b))
+    assert got == [((x + y) * (x - y)) % P for x, y in zip(xs, ys)]
+    # vmap over an extra leading axis
+    a2 = jnp.stack([a, b])
+    b2 = jnp.stack([b, a])
+    out = jax.vmap(f)(a2, b2)
+    assert out.shape == (2, 8, fp.NLIMB)
+
+
+def test_edge_values():
+    xs = [0, 1, 2, P - 1, P - 2, (P + 1) // 2]
+    a = batch_encode(xs)
+    assert batch_decode(fp.mont_mul(a, a)) == [x * x % P for x in xs]
+    assert batch_decode(fp.sub(a, a)) == [0] * len(xs)
