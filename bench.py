@@ -1,0 +1,106 @@
+"""Headline benchmark: batch beacon-chain verification on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Workload (BASELINE.md "chain catch-up" / headline config): N historical
+beacon rounds are verified as batched pairing product checks
+e(-G, sig_i) * e(pk, H_i) == 1 — two Miller loops + one shared final
+exponentiation per round, exactly what `JaxScheme.verify_chain_batch`
+dispatches during sync (drand reference: one sequential pairing per round,
+/root/reference/beacon/beacon.go:575).
+
+The baseline target is 50_000 pairings/sec/chip (BASELINE.json: verify 1M
+rounds < 60 s); vs_baseline = achieved_pairings_per_sec / 50_000.
+
+Environment knobs:
+  BENCH_BATCH   rounds per device call   (default 512)
+  BENCH_ITERS   timed iterations         (default 4)
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from drand_tpu.crypto import refimpl as ref
+    from drand_tpu.ops import curve, fp, pairing, tower
+
+    batch = int(os.environ.get("BENCH_BATCH", "512"))
+    iters = int(os.environ.get("BENCH_ITERS", "4"))
+
+    # --- build a valid workload ------------------------------------------
+    sk = 0x1234567890ABCDEF1234567890ABCDEF % ref.R
+    pk = ref.g1_mul(ref.G1_GEN, sk)
+    neg_g = ref.g1_neg(ref.G1_GEN)
+
+    # "message hashes": distinct G2 points H_i = gen^(r_i), derived on
+    # device; signatures sig_i = H_i^sk.  (Host-side hash_to_curve is the
+    # protocol plane's job; this benchmark measures the device verify path,
+    # which is the reference's per-round pairing bottleneck.)
+    rng = np.random.default_rng(7)
+    scalars = [int(rng.integers(1, 1 << 62)) for _ in range(batch)]
+    bits = jnp.asarray(
+        np.stack([curve.scalar_to_bits(s) for s in scalars])
+    )
+    g2_gen = jnp.broadcast_to(
+        curve.g2_encode(ref.G2_GEN), (batch, 3, 2, 2, fp.NLIMB)
+    )
+    h_proj = curve.g2_scalar_mul(g2_gen, bits)
+    sk_bits = jnp.broadcast_to(
+        jnp.asarray(curve.scalar_to_bits(sk)), (batch, 256)
+    )
+    sig_proj = curve.g2_scalar_mul(h_proj, sk_bits)
+
+    hx, hy = curve.g2_to_affine(h_proj)
+    sx, sy = curve.g2_to_affine(sig_proj)
+    q2 = jnp.stack([hx, hy], axis=1)      # H_i      (batch, 2, 2, NLIMB)
+    q1 = jnp.stack([sx, sy], axis=1)      # sig_i
+    enc_g1 = lambda pt: jnp.stack(
+        [fp.fp_encode(pt[0]), fp.fp_encode(pt[1])]
+    )
+    p1 = jnp.broadcast_to(enc_g1(neg_g), (batch, 2, fp.NLIMB))
+    p2 = jnp.broadcast_to(enc_g1(pk), (batch, 2, fp.NLIMB))
+
+    check = jax.jit(pairing.pairing_product_check)
+
+    # warmup / compile (excluded from timing)
+    ok = np.asarray(check(p1, q1, p2, q2))
+    if not ok.all():
+        print(json.dumps({"error": "verification failed in warmup"}))
+        sys.exit(1)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = check(p1, q1, p2, q2)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = batch * iters / dt
+    pairings_per_sec = 2 * rounds_per_sec
+    print(json.dumps({
+        "metric": "beacon-chain batch-verify throughput "
+                  "(BLS12-381 pairings/sec/chip)",
+        "value": round(pairings_per_sec, 1),
+        "unit": "pairings/sec/chip",
+        "vs_baseline": round(pairings_per_sec / 50_000.0, 4),
+        "detail": {
+            "rounds_per_sec": round(rounds_per_sec, 1),
+            "batch": batch,
+            "iters": iters,
+            "seconds": round(dt, 3),
+            "device": str(jax.devices()[0]),
+            "est_1M_rounds_seconds": round(1_000_000 / rounds_per_sec, 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""Beacon chain: types, verification, storage, and the round-loop handler.
+
+Equivalent of the reference's `beacon/` package — the protocol hot path
+(/root/reference/beacon/beacon.go, beacon/chain.go, beacon/store.go,
+beacon/round_cache.go)."""
+
+from drand_tpu.beacon.chain import (  # noqa: F401
+    Beacon,
+    beacon_message,
+    current_round,
+    genesis_beacon,
+    next_round,
+    randomness,
+    time_of_round,
+    verify_beacon,
+)
+from drand_tpu.beacon.store import BeaconStore, CallbackStore  # noqa: F401
+from drand_tpu.beacon.handler import BeaconHandler, BeaconConfig  # noqa: F401

@@ -1,0 +1,98 @@
+"""Beacon chain types, message derivation, round/time math, verification.
+
+Mirrors /root/reference/beacon/chain.go:
+* `Beacon{Round, PrevRound, PrevSig, Signature}`  (:16-28)
+* randomness = SHA-256(signature)                  (:48-55)
+* message = SHA-256(be8(prevRound) || prevSig || be8(round))  (:86-94)
+* round 0 is a deterministic genesis beacon whose signature is the group's
+  genesis seed (beacon.go:105-113)
+* round<->time math                                (:97-119)
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from drand_tpu.crypto import tbls
+
+
+@dataclass(frozen=True)
+class Beacon:
+    round: int
+    prev_round: int
+    prev_sig: bytes
+    signature: bytes
+
+    def randomness(self) -> bytes:
+        return randomness(self.signature)
+
+    def to_dict(self) -> dict:
+        return {
+            "round": self.round,
+            "prev_round": self.prev_round,
+            "prev_sig": self.prev_sig.hex(),
+            "signature": self.signature.hex(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Beacon":
+        return cls(
+            round=int(d["round"]),
+            prev_round=int(d["prev_round"]),
+            prev_sig=bytes.fromhex(d["prev_sig"]),
+            signature=bytes.fromhex(d["signature"]),
+        )
+
+
+def randomness(signature: bytes) -> bytes:
+    return hashlib.sha256(signature).digest()
+
+
+def round_to_bytes(r: int) -> bytes:
+    return int(r).to_bytes(8, "big")
+
+
+def beacon_message(prev_sig: bytes, prev_round: int, round: int) -> bytes:
+    """The message each node threshold-signs for a round."""
+    h = hashlib.sha256()
+    h.update(round_to_bytes(prev_round))
+    h.update(prev_sig)
+    h.update(round_to_bytes(round))
+    return h.digest()
+
+
+def genesis_beacon(genesis_seed: bytes) -> Beacon:
+    """Round 0: deterministic from the group's genesis seed."""
+    return Beacon(round=0, prev_round=0, prev_sig=b"", signature=genesis_seed)
+
+
+def verify_beacon(scheme: tbls.Scheme, pub_key, beacon: Beacon) -> None:
+    """Raise if the beacon's signature is not the group's tBLS signature
+    over the chained message (reference VerifyBeacon chain.go:65)."""
+    msg = beacon_message(beacon.prev_sig, beacon.prev_round, beacon.round)
+    scheme.verify_recovered(pub_key, msg, beacon.signature)
+
+
+def time_of_round(period: float, genesis_time: int, round: int) -> float:
+    """Scheduled wall time of a round (round 1 happens at genesis)."""
+    if round == 0:
+        return float(genesis_time)
+    return genesis_time + (round - 1) * period
+
+
+def current_round(now: float, period: float, genesis_time: int) -> int:
+    """The round whose scheduled time is the latest not after `now`."""
+    if now < genesis_time:
+        return 0
+    return int((now - genesis_time) // period) + 1
+
+
+def next_round(now: float, period: float,
+               genesis_time: int) -> Tuple[int, float]:
+    """The upcoming round and its scheduled time (chain.go:108-119)."""
+    if now < genesis_time:
+        return 1, float(genesis_time)
+    nxt = current_round(now, period, genesis_time) + 1
+    return nxt, time_of_round(period, genesis_time, nxt)

@@ -1,0 +1,343 @@
+"""The beacon round loop — the protocol hot path.
+
+Mirrors /root/reference/beacon/beacon.go semantics:
+
+* a period ticker drives rounds; **the ticker is king** (:390-399): when a
+  new round's time arrives the previous round attempt is abandoned, the
+  new round always targets the chain head we actually have;
+* each round: sign own partial over the chained message, broadcast to all
+  peers, collect partials until the threshold, Lagrange-recover the unique
+  group signature, verify it against the distributed key, store it
+  (:429-526);
+* catch-up pulls the missing chain segment from peers, verifying every
+  link (:529-601) — here in device-sized batches via the scheme's
+  `verify_chain_batch` (the TPU replacement for the reference's
+  one-pairing-per-iteration loop);
+* resharing uses `stop_at` (old group stops at transition-1,
+  beacon.go:626) and `transition` (new group syncs then joins, :244).
+
+The handler is asyncio-native; time is injectable (utils.clock) so tests
+drive rounds deterministically, mirroring the reference's clockwork usage.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Callable, Dict, List, Optional
+
+from drand_tpu.beacon.chain import (
+    Beacon,
+    beacon_message,
+    current_round,
+    genesis_beacon,
+    next_round,
+    time_of_round,
+    verify_beacon,
+)
+from drand_tpu.beacon.round_cache import RoundManager
+from drand_tpu.beacon.store import BeaconStore, CallbackStore
+from drand_tpu.crypto import tbls
+from drand_tpu.key import Group, Identity, Share
+from drand_tpu.utils.clock import Clock
+
+log = logging.getLogger("drand_tpu.beacon")
+
+#: how many sync'd beacons to verify per device batch
+SYNC_BATCH = 64
+
+
+@dataclass
+class BeaconPacket:
+    """Wire content of a partial-signature broadcast (NewBeacon RPC)."""
+
+    from_address: str
+    round: int
+    prev_round: int
+    prev_sig: bytes
+    partial_sig: bytes
+
+    def to_dict(self) -> dict:
+        return {
+            "from_address": self.from_address,
+            "round": self.round,
+            "prev_round": self.prev_round,
+            "prev_sig": self.prev_sig.hex(),
+            "partial_sig": self.partial_sig.hex(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BeaconPacket":
+        return cls(
+            from_address=d["from_address"],
+            round=int(d["round"]),
+            prev_round=int(d["prev_round"]),
+            prev_sig=bytes.fromhex(d["prev_sig"]),
+            partial_sig=bytes.fromhex(d["partial_sig"]),
+        )
+
+
+class ProtocolClient:
+    """Outbound protocol-plane transport (gRPC or in-process loopback)."""
+
+    async def new_beacon(self, peer: Identity,
+                         packet: BeaconPacket) -> None:
+        raise NotImplementedError
+
+    def sync_chain(self, peer: Identity,
+                   from_round: int) -> AsyncIterator[Beacon]:
+        raise NotImplementedError
+
+
+@dataclass
+class BeaconConfig:
+    group: Group
+    public: Identity
+    share: Share
+    scheme: tbls.Scheme
+    clock: Clock = field(default_factory=Clock)
+    wait_time: float = 0.3  # reference core/constants.go:45
+
+
+class BeaconHandler:
+    def __init__(self, cfg: BeaconConfig, store: BeaconStore,
+                 client: ProtocolClient):
+        self.cfg = cfg
+        self.group = cfg.group
+        self.scheme = cfg.scheme
+        self.clock = cfg.clock
+        self.client = client
+        self.store = CallbackStore(store)
+        idx = cfg.group.index(cfg.public)
+        if idx is None:
+            raise ValueError("this node is not part of the group")
+        self.index = idx
+        self.pub_poly = cfg.share.pub_poly()
+        self.dist_key = cfg.share.public().key()
+        self.manager = RoundManager(self.scheme.index_of)
+        self._running = False
+        self._stop_at: Optional[int] = None
+        self._loop_task: Optional[asyncio.Task] = None
+        self._round_task: Optional[asyncio.Task] = None
+        self._stopped = asyncio.Event()
+
+    # -- public control ---------------------------------------------------
+
+    async def start(self) -> None:
+        """Start at genesis (fails if genesis already passed;
+        reference beacon.go:205)."""
+        if self.clock.now() > self.group.genesis_time + self.group.period:
+            raise RuntimeError(
+                "genesis time already passed — use catchup()"
+            )
+        self._ensure_genesis()
+        self._launch()
+
+    async def catchup(self) -> None:
+        """Join a running chain: sync from peers, then enter the loop."""
+        self._ensure_genesis()
+        await self.sync()
+        self._launch()
+
+    async def transition(self) -> None:
+        """New-group node during resharing: sync the old chain up to the
+        transition round, then run (reference Transition beacon.go:244)."""
+        self._ensure_genesis()
+        await self.sync()
+        self._launch()
+
+    async def transition_with_peers(self, peers) -> None:
+        """Transition, syncing the existing chain from the OLD group's
+        nodes (a brand-new member knows no new-group chain yet)."""
+        self._ensure_genesis()
+        await self.sync(peers=peers)
+        self._launch()
+
+    def stop_at(self, round: int) -> None:
+        """Stop producing after storing `round` (old nodes at reshare)."""
+        self._stop_at = round
+
+    async def stop(self) -> None:
+        self._running = False
+        for t in (self._round_task, self._loop_task):
+            if t is not None:
+                t.cancel()
+        await asyncio.sleep(0)
+        self._stopped.set()
+
+    def add_callback(self, cb: Callable[[Beacon], None]) -> None:
+        self.store.add_callback(cb)
+
+    # -- internals --------------------------------------------------------
+
+    def _ensure_genesis(self) -> None:
+        if self.store.get(0) is None:
+            self.store.put(genesis_beacon(self.group.get_genesis_seed()))
+
+    def _launch(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._loop_task = asyncio.create_task(self._run_loop())
+
+    async def _run_loop(self) -> None:
+        period = self.group.period
+        genesis = self.group.genesis_time
+        while self._running:
+            now = self.clock.now()
+            if now < genesis:
+                await self.clock.sleep(genesis - now)
+                continue
+            head = self.store.last()
+            cur = current_round(now, period, genesis)
+            if head is not None and head.round >= cur:
+                # head is fresh: just wait for the next scheduled round
+                _, t_next = next_round(now, period, genesis)
+                await self.clock.sleep(t_next - self.clock.now())
+                continue
+            if self._stop_at is not None and cur > self._stop_at:
+                self._running = False
+                self._stopped.set()
+                return
+            # ticker is king: abandon any unfinished previous round and
+            # work on the round the clock says is current
+            if self._round_task is not None and not self._round_task.done():
+                self._round_task.cancel()
+            self._round_task = asyncio.create_task(self._run_round(cur))
+            _, t_next = next_round(now, period, genesis)
+            await self.clock.sleep(t_next - self.clock.now())
+
+    async def _run_round(self, round: int) -> None:
+        head = self.store.last()
+        if head is None or head.round >= round:
+            return
+        prev_round, prev_sig = head.round, head.signature
+        msg = beacon_message(prev_sig, prev_round, round)
+        own = self.scheme.partial_sign(self.cfg.share.share, msg)
+        queue = self.manager.new_round(round)
+        self.manager.add_partial(round, own)
+        packet = BeaconPacket(
+            from_address=self.cfg.public.address,
+            round=round,
+            prev_round=prev_round,
+            prev_sig=prev_sig,
+            partial_sig=own,
+        )
+        for node in self.group.nodes:
+            if node.address == self.cfg.public.address:
+                continue
+            asyncio.create_task(self._send_packet(node, packet))
+
+        partials: Dict[int, bytes] = {self.index: own}
+        while len(partials) < self.group.threshold:
+            blob = await queue.get()
+            partials[self.scheme.index_of(blob)] = blob
+
+        sig = self.scheme.recover(
+            self.pub_poly, msg, list(partials.values()),
+            self.group.threshold, len(self.group),
+        )
+        beacon = Beacon(round=round, prev_round=prev_round,
+                        prev_sig=prev_sig, signature=sig)
+        verify_beacon(self.scheme, self.dist_key, beacon)
+        # the head may have advanced while we were collecting (sync race)
+        cur_head = self.store.last()
+        if cur_head is not None and cur_head.round >= round:
+            return
+        self.store.put(beacon)
+        log.debug("node %s stored round %s", self.index, round)
+        if self._stop_at is not None and round >= self._stop_at:
+            self._running = False
+            self._stopped.set()
+
+    async def _send_packet(self, node: Identity,
+                           packet: BeaconPacket) -> None:
+        try:
+            await self.client.new_beacon(node, packet)
+        except Exception as exc:  # peer down — the threshold absorbs it
+            log.debug("broadcast to %s failed: %s", node.address, exc)
+
+    # -- inbound RPCs ------------------------------------------------------
+
+    async def process_beacon(self, packet: BeaconPacket) -> None:
+        """Inbound partial signature (reference ProcessBeacon :124-160)."""
+        now = self.clock.now()
+        cur = current_round(now, self.group.period, self.group.genesis_time)
+        # round sanity window: current, the next, or the previous round
+        if packet.round < cur - 1 or packet.round > cur + 1:
+            raise ValueError(
+                f"round {packet.round} out of window (current {cur})"
+            )
+        msg = beacon_message(packet.prev_sig, packet.prev_round,
+                             packet.round)
+        self.scheme.verify_partial(self.pub_poly, msg, packet.partial_sig)
+        idx = self.scheme.index_of(packet.partial_sig)
+        if idx == self.index:
+            return
+        self.manager.add_partial(packet.round, packet.partial_sig)
+
+    def sync_chain_from(self, from_round: int) -> List[Beacon]:
+        """Serve our chain from a round (reference SyncChain :170-194)."""
+        return self.store.range_from(from_round)
+
+    # -- catch-up ----------------------------------------------------------
+
+    async def sync(self, peers=None) -> None:
+        """Pull missing beacons from peers, batch-verifying each segment.
+
+        The reference verifies one pairing per synced round in a serial
+        loop (beacon.go:557-601); here segments of SYNC_BATCH rounds are
+        verified in a single batched device call.
+        """
+        peers = [n for n in (peers or self.group.nodes)
+                 if n.address != self.cfg.public.address]
+        random.shuffle(peers)
+        for peer in peers:
+            try:
+                await self._sync_from(peer)
+            except Exception as exc:
+                log.debug("sync from %s failed: %s", peer.address, exc)
+            head = self.store.last()
+            now = self.clock.now()
+            cur = current_round(now, self.group.period,
+                                self.group.genesis_time)
+            if head is not None and head.round >= cur - 1:
+                return  # caught up enough to join
+
+    async def _sync_from(self, peer: Identity) -> None:
+        head = self.store.last()
+        assert head is not None
+        batch: List[Beacon] = []
+        async for b in self.client.sync_chain(peer, head.round + 1):
+            batch.append(b)
+            if len(batch) >= SYNC_BATCH:
+                head = self._verify_and_store(head, batch)
+                batch = []
+        if batch:
+            self._verify_and_store(head, batch)
+
+    def _verify_and_store(self, head: Beacon,
+                          batch: List[Beacon]) -> Beacon:
+        # chain-link checks (cheap, host side)
+        prev = head
+        for b in batch:
+            if b.prev_round != prev.round or b.prev_sig != prev.signature \
+                    or b.round <= prev.round:
+                raise ValueError(
+                    f"chain link broken at round {b.round}"
+                )
+            prev = b
+        msgs = [
+            beacon_message(b.prev_sig, b.prev_round, b.round)
+            for b in batch
+        ]
+        sigs = [b.signature for b in batch]
+        ok = self.scheme.verify_chain_batch(self.dist_key, msgs, sigs)
+        if not all(ok):
+            bad = [batch[i].round for i, v in enumerate(ok) if not v]
+            raise ValueError(f"invalid signatures at rounds {bad}")
+        for b in batch:
+            self.store.put(b)
+        return batch[-1]

@@ -1,0 +1,55 @@
+"""Per-round partial-signature collection with dedup and look-ahead.
+
+Mirrors /root/reference/beacon/round_cache.go: the reference serializes all
+partial handling through one goroutine with a 1024-slot look-ahead buffer
+for future-round partials (:33) and dedups by signer index (:113-118).
+Here the asyncio event loop provides the serialization; the manager keeps
+one queue for the active round and buffers bounded future-round partials.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+MAX_LOOKAHEAD = 1024
+
+
+class RoundManager:
+    def __init__(self, index_of):
+        self._index_of = index_of          # partial bytes -> signer index
+        self._round: Optional[int] = None
+        self._queue: Optional[asyncio.Queue] = None
+        self._seen: set = set()
+        self._future: Dict[int, List[bytes]] = {}
+        self._buffered = 0
+
+    def new_round(self, round: int) -> asyncio.Queue:
+        """Activate a round; flush any buffered partials for it."""
+        self._round = round
+        self._queue = asyncio.Queue()
+        self._seen = set()
+        for blob in self._future.pop(round, []):
+            self._buffered -= 1
+            self._offer(blob)
+        # drop stale buffered rounds
+        for r in [r for r in self._future if r <= round]:
+            self._buffered -= len(self._future.pop(r))
+        return self._queue
+
+    def _offer(self, blob: bytes) -> None:
+        idx = self._index_of(blob)
+        if idx in self._seen:
+            return
+        self._seen.add(idx)
+        assert self._queue is not None
+        self._queue.put_nowait(blob)
+
+    def add_partial(self, round: int, blob: bytes) -> None:
+        if self._round is not None and round == self._round:
+            self._offer(blob)
+        elif (self._round is None or round > self._round) and \
+                self._buffered < MAX_LOOKAHEAD:
+            self._future.setdefault(round, []).append(blob)
+            self._buffered += 1
+        # else: stale round — drop

@@ -1,0 +1,150 @@
+"""Beacon persistence: an embedded K/V store with cursor iteration.
+
+Mirrors /root/reference/beacon/store.go (boltdb keyed by big-endian round;
+`Store{Len,Put,Last,Get,Cursor,Close}`, `Cursor{First,Next,Seek,Last}`,
+plus the callback-decorated store :234).  Backed by sqlite3 — embedded,
+transactional, ubiquitous; ":memory:" gives the test store.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Callable, Iterator, List, Optional
+
+from drand_tpu.beacon.chain import Beacon
+
+
+class BeaconStore:
+    def __init__(self, path: str = ":memory:"):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS beacons ("
+                " round INTEGER PRIMARY KEY,"
+                " prev_round INTEGER NOT NULL,"
+                " prev_sig BLOB NOT NULL,"
+                " signature BLOB NOT NULL)"
+            )
+            self._db.commit()
+
+    def __len__(self) -> int:
+        with self._lock:
+            (n,) = self._db.execute(
+                "SELECT COUNT(*) FROM beacons"
+            ).fetchone()
+        return int(n)
+
+    def put(self, b: Beacon) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO beacons VALUES (?,?,?,?)",
+                (b.round, b.prev_round, b.prev_sig, b.signature),
+            )
+            self._db.commit()
+
+    @staticmethod
+    def _row_to_beacon(row) -> Beacon:
+        return Beacon(
+            round=int(row[0]),
+            prev_round=int(row[1]),
+            prev_sig=bytes(row[2]),
+            signature=bytes(row[3]),
+        )
+
+    def get(self, round: int) -> Optional[Beacon]:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT * FROM beacons WHERE round=?", (round,)
+            ).fetchone()
+        return self._row_to_beacon(row) if row else None
+
+    def last(self) -> Optional[Beacon]:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT * FROM beacons ORDER BY round DESC LIMIT 1"
+            ).fetchone()
+        return self._row_to_beacon(row) if row else None
+
+    def cursor(self) -> "Cursor":
+        return Cursor(self)
+
+    def range_from(self, from_round: int,
+                   limit: Optional[int] = None) -> List[Beacon]:
+        """All beacons with round >= from_round, ascending (sync streams)."""
+        q = "SELECT * FROM beacons WHERE round>=? ORDER BY round ASC"
+        args: tuple = (from_round,)
+        if limit is not None:
+            q += " LIMIT ?"
+            args = (from_round, limit)
+        with self._lock:
+            rows = self._db.execute(q, args).fetchall()
+        return [self._row_to_beacon(r) for r in rows]
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+
+class Cursor:
+    """Iteration over the chain in round order (reference store.go:40-45)."""
+
+    def __init__(self, store: BeaconStore):
+        self._store = store
+        self._round: Optional[int] = None
+
+    def _fetch(self, q: str, args=()) -> Optional[Beacon]:
+        with self._store._lock:
+            row = self._store._db.execute(q, args).fetchone()
+        if row is None:
+            return None
+        b = BeaconStore._row_to_beacon(row)
+        self._round = b.round
+        return b
+
+    def first(self) -> Optional[Beacon]:
+        return self._fetch("SELECT * FROM beacons ORDER BY round ASC LIMIT 1")
+
+    def last(self) -> Optional[Beacon]:
+        return self._fetch("SELECT * FROM beacons ORDER BY round DESC LIMIT 1")
+
+    def seek(self, round: int) -> Optional[Beacon]:
+        return self._fetch(
+            "SELECT * FROM beacons WHERE round>=? ORDER BY round ASC LIMIT 1",
+            (round,),
+        )
+
+    def next(self) -> Optional[Beacon]:
+        if self._round is None:
+            return self.first()
+        return self._fetch(
+            "SELECT * FROM beacons WHERE round>? ORDER BY round ASC LIMIT 1",
+            (self._round,),
+        )
+
+
+class CallbackStore:
+    """Store decorator invoking callbacks on every new beacon
+    (reference NewCallbackStore store.go:234)."""
+
+    def __init__(self, inner: BeaconStore):
+        self._inner = inner
+        self._callbacks: List[Callable[[Beacon], None]] = []
+
+    def add_callback(self, cb: Callable[[Beacon], None]) -> None:
+        self._callbacks.append(cb)
+
+    def put(self, b: Beacon) -> None:
+        self._inner.put(b)
+        for cb in list(self._callbacks):
+            try:
+                cb(b)
+            except Exception:  # callbacks must never break the chain
+                pass
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __len__(self):
+        return len(self._inner)

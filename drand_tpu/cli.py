@@ -1,0 +1,350 @@
+"""Operator CLI.
+
+Mirrors the reference's urfave/cli surface (/root/reference/main.go:189-378
+and daemon.go/control.go/public.go):
+
+  drand-tpu generate-keypair <address>     create the long-term keypair
+  drand-tpu group <key files...>           build a group.toml
+  drand-tpu check-group <group.toml>       probe reachability of all nodes
+  drand-tpu start                          run the daemon
+  drand-tpu stop                           stop via the control port
+  drand-tpu share <group.toml> [--leader]  run the DKG (or reshare with
+                                           --from-group)
+  drand-tpu get public|private <group.toml> --node <addr>
+  drand-tpu ping                           control-port liveness
+  drand-tpu show share|group|public|private|cokey
+  drand-tpu reset                          wipe beacon + share state
+
+Run as `python -m drand_tpu.cli ...`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import shutil
+import sys
+import time
+import tomllib
+from pathlib import Path
+
+from drand_tpu.key import (
+    FileStore,
+    Group,
+    Identity,
+    Pair,
+    default_threshold,
+)
+from drand_tpu.key.store import KeyNotFound
+from drand_tpu.utils import parse_duration, toml_dumps
+
+DEFAULT_FOLDER = "~/.drand-tpu"
+DEFAULT_CONTROL = 8888
+
+
+def _store(args) -> FileStore:
+    return FileStore(os.path.expanduser(args.folder))
+
+
+def cmd_generate_keypair(args) -> int:
+    store = _store(args)
+    pair = Pair.generate(args.address, tls=args.tls)
+    store.save_key_pair(pair)
+    pub_path = Path(os.path.expanduser(args.folder)) / "key" / "public.toml"
+    pub_path.write_text(toml_dumps(pair.public.to_dict()))
+    print(f"generated keypair for {args.address}")
+    print(f"public key file: {pub_path}")
+    return 0
+
+
+def cmd_group(args) -> int:
+    nodes = []
+    for path in args.keys:
+        with open(path, "rb") as fh:
+            nodes.append(Identity.from_dict(tomllib.load(fh)))
+    threshold = args.threshold or default_threshold(len(nodes))
+    genesis = args.genesis or int(time.time()) + 60
+    group = Group(
+        nodes=nodes,
+        threshold=threshold,
+        period=parse_duration(args.period),
+        genesis_time=genesis,
+    )
+    group.get_genesis_seed()
+    out = args.out or "group.toml"
+    Path(out).write_text(toml_dumps(group.to_dict()))
+    print(f"wrote {out}: {len(nodes)} nodes, threshold {threshold}, "
+          f"period {args.period}, genesis {genesis}")
+    return 0
+
+
+def cmd_check_group(args) -> int:
+    from drand_tpu.net import GrpcClient
+
+    with open(args.group, "rb") as fh:
+        group = Group.from_dict(tomllib.load(fh))
+
+    async def probe() -> int:
+        client = GrpcClient()
+        failures = 0
+        for node in group.nodes:
+            try:
+                await client.home(node)
+                print(f"  ok    {node.address}")
+            except Exception as exc:
+                print(f"  FAIL  {node.address}: {exc}")
+                failures += 1
+        await client.close()
+        return failures
+
+    bad = asyncio.run(probe())
+    print(f"{len(group.nodes) - bad}/{len(group.nodes)} nodes reachable")
+    return 1 if bad else 0
+
+
+def cmd_start(args) -> int:
+    from drand_tpu.core import Config, Drand
+    from drand_tpu.crypto import tbls
+
+    async def run():
+        store = _store(args)
+        pair = store.load_key_pair()
+        cfg = Config(
+            base_folder=args.folder,
+            listen_addr=args.listen or pair.public.address,
+            control_port=args.control,
+            rest_port=args.rest_port,
+            scheme=tbls.default_scheme(args.backend),
+        )
+        try:
+            store.load_group()
+            daemon = await Drand.load(cfg, pair)
+            print("loaded existing beacon state; catching up")
+        except KeyNotFound:
+            daemon = await Drand.new(cfg, pair)
+            print("fresh node: waiting for DKG "
+                  f"(control port {args.control})")
+        await daemon.wait_exit()
+
+    asyncio.run(run())
+    return 0
+
+
+def _control(args):
+    from drand_tpu.net import ControlClient
+
+    return ControlClient(args.control)
+
+
+def cmd_stop(args) -> int:
+    async def run():
+        c = _control(args)
+        await c.shutdown()
+        await c.close()
+
+    asyncio.run(run())
+    print("daemon stopped")
+    return 0
+
+
+def cmd_ping(args) -> int:
+    async def run():
+        c = _control(args)
+        await c.ping()
+        await c.close()
+
+    asyncio.run(run())
+    print("pong")
+    return 0
+
+
+def cmd_share(args) -> int:
+    group_toml = Path(args.group).read_text()
+
+    async def run() -> str:
+        c = _control(args)
+        try:
+            if args.from_group:
+                old_toml = Path(args.from_group).read_text()
+                return await c.init_reshare(
+                    new_group_toml=group_toml,
+                    old_group_toml=old_toml,
+                    is_leader=args.leader,
+                    timeout=args.timeout,
+                )
+            if args.reshare:
+                return await c.init_reshare(
+                    new_group_toml=group_toml,
+                    is_leader=args.leader,
+                    timeout=args.timeout,
+                )
+            return await c.init_dkg(
+                group_toml, is_leader=args.leader, timeout=args.timeout
+            )
+        finally:
+            await c.close()
+
+    dist = asyncio.run(run())
+    if dist:
+        print(f"distributed key: {dist}")
+    else:
+        print("done (this node holds no share in the new group)")
+    return 0
+
+
+def cmd_get(args) -> int:
+    from drand_tpu.core import DrandClient
+    from drand_tpu.crypto import refimpl as ref
+
+    with open(args.group, "rb") as fh:
+        group = Group.from_dict(tomllib.load(fh))
+    node = None
+    for n in group.nodes:
+        if args.node in (None, n.address):
+            node = n
+            break
+    if node is None:
+        print(f"node {args.node} not in group", file=sys.stderr)
+        return 1
+
+    async def run() -> int:
+        if args.kind == "private":
+            client = DrandClient(dist_key=None)
+            out = await client.private(node)
+            print(out.hex())
+            await client.close()
+            return 0
+        # public randomness requires the distributed key to verify
+        if not args.distkey:
+            print("--distkey <hex> required for verified public "
+                  "randomness", file=sys.stderr)
+            return 1
+        dist = ref.g1_from_bytes(bytes.fromhex(args.distkey))
+        client = DrandClient(dist)
+        b = (await client.public(node, args.round) if args.round
+             else await client.last_public(node))
+        print(toml_dumps({
+            "Round": b.round,
+            "Signature": b.signature.hex(),
+            "Randomness": b.randomness().hex(),
+        }))
+        await client.close()
+        return 0
+
+    return asyncio.run(run())
+
+
+def cmd_show(args) -> int:
+    async def run() -> int:
+        c = _control(args)
+        try:
+            if args.what == "share":
+                idx, hexv = await c.share()
+                print(toml_dumps({"Index": idx, "Share": hexv}))
+            elif args.what == "group":
+                print(await c.group_file())
+            elif args.what == "public":
+                print(await c.public_key())
+            elif args.what == "private":
+                print(await c.private_key())
+            elif args.what == "cokey":
+                for coeff in await c.collective_key():
+                    print(coeff)
+            return 0
+        finally:
+            await c.close()
+
+    return asyncio.run(run())
+
+
+def cmd_reset(args) -> int:
+    base = Path(os.path.expanduser(args.folder))
+    removed = []
+    for rel in ["db", "groups/dist_key.public.toml",
+                "key/dist_key.private.toml", "groups/drand_group.toml"]:
+        p = base / rel
+        if p.is_dir():
+            shutil.rmtree(p)
+            removed.append(rel)
+        elif p.exists():
+            p.unlink()
+            removed.append(rel)
+    print(f"reset: removed {removed or 'nothing'}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="drand-tpu",
+        description="TPU-native distributed randomness beacon",
+    )
+    p.add_argument("--folder", default=DEFAULT_FOLDER,
+                   help="base config folder")
+    p.add_argument("--control", type=int, default=DEFAULT_CONTROL,
+                   help="control port")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("generate-keypair")
+    g.add_argument("address")
+    g.add_argument("--tls", action="store_true")
+    g.set_defaults(fn=cmd_generate_keypair)
+
+    g = sub.add_parser("group")
+    g.add_argument("keys", nargs="+", help="public key TOML files")
+    g.add_argument("--threshold", type=int)
+    g.add_argument("--period", default="1m")
+    g.add_argument("--genesis", type=int)
+    g.add_argument("--out")
+    g.set_defaults(fn=cmd_group)
+
+    g = sub.add_parser("check-group")
+    g.add_argument("group")
+    g.set_defaults(fn=cmd_check_group)
+
+    g = sub.add_parser("start")
+    g.add_argument("--listen")
+    g.add_argument("--rest-port", type=int)
+    g.add_argument("--backend", choices=["ref", "jax"], default="ref")
+    g.set_defaults(fn=cmd_start)
+
+    g = sub.add_parser("stop")
+    g.set_defaults(fn=cmd_stop)
+
+    g = sub.add_parser("ping")
+    g.set_defaults(fn=cmd_ping)
+
+    g = sub.add_parser("share")
+    g.add_argument("group")
+    g.add_argument("--leader", action="store_true")
+    g.add_argument("--timeout", type=float)
+    g.add_argument("--reshare", action="store_true",
+                   help="reshare using the daemon's stored group")
+    g.add_argument("--from-group", help="old group TOML (reshare)")
+    g.set_defaults(fn=cmd_share)
+
+    g = sub.add_parser("get")
+    g.add_argument("kind", choices=["public", "private"])
+    g.add_argument("group")
+    g.add_argument("--node")
+    g.add_argument("--round", type=int, default=0)
+    g.add_argument("--distkey")
+    g.set_defaults(fn=cmd_get)
+
+    g = sub.add_parser("show")
+    g.add_argument("what",
+                   choices=["share", "group", "public", "private", "cokey"])
+    g.set_defaults(fn=cmd_show)
+
+    g = sub.add_parser("reset")
+    g.set_defaults(fn=cmd_reset)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

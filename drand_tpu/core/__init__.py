@@ -1,0 +1,8 @@
+"""Daemon orchestration: ties keys, DKG, beacon, networking together.
+
+Equivalent of the reference's `core/` package (/root/reference/core/):
+the `Drand` daemon, its control-plane handlers, the verifying client
+library, and configuration."""
+
+from drand_tpu.core.daemon import Config, Drand  # noqa: F401
+from drand_tpu.core.client import DrandClient  # noqa: F401

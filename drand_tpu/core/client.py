@@ -1,0 +1,73 @@
+"""Verifying client library.
+
+Mirrors /root/reference/core/client_public.go: fetch public randomness
+(latest or by round) over gRPC, verify the threshold-BLS signature against
+the distributed key and check randomness == SHA-256(signature) (:107-127);
+ECIES private-randomness round trip (:78-94).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from drand_tpu.beacon.chain import Beacon, beacon_message, randomness
+from drand_tpu.crypto import ecies
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.poly import rand_scalar
+from drand_tpu.key import Identity
+from drand_tpu.net import CertManager, GrpcClient
+
+
+class VerificationError(Exception):
+    pass
+
+
+class DrandClient:
+    """Client that refuses to return unverified randomness."""
+
+    def __init__(self, dist_key, scheme: Optional[tbls.Scheme] = None,
+                 certs: Optional[CertManager] = None):
+        self.dist_key = dist_key          # collective G1 public key
+        self.scheme = scheme or tbls.default_scheme()
+        self._net = GrpcClient(certs)
+
+    async def close(self):
+        await self._net.close()
+
+    def _verify(self, resp) -> Beacon:
+        b = Beacon(
+            round=resp.round,
+            prev_round=resp.previous_round,
+            prev_sig=resp.previous_signature,
+            signature=resp.signature,
+        )
+        msg = beacon_message(b.prev_sig, b.prev_round, b.round)
+        try:
+            self.scheme.verify_recovered(self.dist_key, msg, b.signature)
+        except tbls.ThresholdError as exc:
+            raise VerificationError(str(exc)) from exc
+        if resp.randomness and resp.randomness != randomness(b.signature):
+            raise VerificationError("randomness != SHA-256(signature)")
+        return b
+
+    async def last_public(self, peer: Identity) -> Beacon:
+        return self._verify(await self._net.public_rand(peer, 0))
+
+    async def public(self, peer: Identity, round: int) -> Beacon:
+        return self._verify(await self._net.public_rand(peer, round))
+
+    async def private(self, peer: Identity) -> bytes:
+        """Private randomness: send an ECIES-wrapped ephemeral key, get
+        32 bytes encrypted back to it."""
+        eph = rand_scalar()
+        eph_pub = ref.g1_mul(ref.G1_GEN, eph)
+        request = ecies.encrypt(peer.key, ref.g1_to_bytes(eph_pub))
+        blob = await self._net.private_rand(peer, request)
+        out = ecies.decrypt(eph, blob)
+        if len(out) != 32:
+            raise VerificationError("expected 32 bytes of randomness")
+        return out
+
+    async def group(self, peer: Identity) -> str:
+        return await self._net.group(peer)

@@ -1,0 +1,453 @@
+"""The drand_tpu daemon.
+
+Mirrors /root/reference/core/drand.go + drand_control.go + drand_public.go:
+
+* boot: load keypair, start the public gateway (gRPC), the localhost
+  control server, and optionally the REST gateway (`NewDrand`/`LoadDrand`,
+  core/drand.go:62,114);
+* `init_dkg` / `init_reshare`: the control-plane entry points that
+  validate the group, run the DKG handler, persist share/group/distkey and
+  start (or transition) the beacon (`InitDKG` core/drand_control.go:27,
+  `InitReshare` :91, `WaitDKG` core/drand.go:150, `transition` :234);
+* public services: current/old beacons, streaming, ECIES private
+  randomness (`PublicRand` core/drand_public.go:78, `PrivateRand` :132);
+* protocol services: partial-signature intake and chain-sync serving.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import secrets
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Set
+
+from drand_tpu.beacon import (
+    Beacon,
+    BeaconConfig,
+    BeaconHandler,
+    BeaconStore,
+    current_round,
+    time_of_round,
+)
+from drand_tpu.beacon.handler import BeaconPacket
+from drand_tpu.crypto import ecies
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto import tbls
+from drand_tpu.dkg import DKGConfig, DKGHandler
+from drand_tpu.key import (
+    DistPublic,
+    FileStore,
+    Group,
+    Identity,
+    Pair,
+    Share,
+)
+from drand_tpu.key.store import KeyNotFound, MemStore
+from drand_tpu.net import (
+    CertManager,
+    GrpcClient,
+    build_control_server,
+    build_public_server,
+)
+from drand_tpu.utils import toml_dumps
+from drand_tpu.utils.clock import Clock
+
+log = logging.getLogger("drand_tpu.core")
+
+MIN_GROUP_SIZE = 4          # reference core/drand_control.go:356
+DEFAULT_CONTROL_PORT = 8888  # reference net/control.go:21
+DEFAULT_DKG_TIMEOUT = 60.0
+
+
+@dataclass
+class Config:
+    """Daemon configuration (reference core/config.go functional options,
+    flattened into a dataclass)."""
+
+    base_folder: str = "~/.drand-tpu"
+    listen_addr: str = "127.0.0.1:0"     # bind address for the gateway
+    public_addr: Optional[str] = None    # address peers dial (default: listen)
+    control_port: int = DEFAULT_CONTROL_PORT
+    rest_port: Optional[int] = None      # REST gateway (None = disabled)
+    tls_cert: Optional[bytes] = None     # PEM (with tls_key enables TLS)
+    tls_key: Optional[bytes] = None
+    cert_manager: CertManager = field(default_factory=CertManager)
+    clock: Clock = field(default_factory=Clock)
+    scheme: Optional[tbls.Scheme] = None
+    dkg_timeout: float = DEFAULT_DKG_TIMEOUT
+    insecure: bool = True                # no TLS (tests / local demos)
+    in_memory: bool = False              # MemStore + in-memory beacon db
+
+
+class Drand:
+    """One daemon process (reference core/drand.go:23-58)."""
+
+    def __init__(self, cfg: Config, pair: Pair):
+        self.cfg = cfg
+        self.pair = pair
+        self.clock = cfg.clock
+        self.scheme = cfg.scheme or tbls.default_scheme()
+        if cfg.in_memory:
+            self.key_store = MemStore(pair)
+        else:
+            base = os.path.expanduser(cfg.base_folder)
+            self.key_store = FileStore(base)
+            self.key_store.save_key_pair(pair)
+        self.group: Optional[Group] = None
+        self.share: Optional[Share] = None
+        self.dist: Optional[DistPublic] = None
+        self.beacon: Optional[BeaconHandler] = None
+        self._beacon_store: Optional[BeaconStore] = None
+        self.dkg: Optional[DKGHandler] = None
+        self._dkg_group: Optional[Group] = None
+        self._client = GrpcClient(cfg.cert_manager)
+        self._servers: List = []
+        self._subscribers: Set[asyncio.Queue] = set()
+        self._exit = asyncio.Event()
+        self._listen_port: Optional[int] = None
+
+    # ------------------------------------------------------------------ boot
+
+    @classmethod
+    async def new(cls, cfg: Config, pair: Optional[Pair] = None) -> "Drand":
+        """Fresh daemon: keypair only, waiting for a DKG."""
+        if pair is None:
+            store = (
+                MemStore() if cfg.in_memory
+                else FileStore(os.path.expanduser(cfg.base_folder))
+            )
+            pair = store.load_key_pair()
+        d = cls(cfg, pair)
+        await d._start_listeners()
+        return d
+
+    @classmethod
+    async def load(cls, cfg: Config,
+                   pair: Optional[Pair] = None) -> "Drand":
+        """Existing daemon: restore group/share/distkey and catch up
+        (reference LoadDrand core/drand.go:114 + daemon.go:42)."""
+        d = await cls.new(cfg, pair)
+        d.group = d.key_store.load_group()
+        d.share = d.key_store.load_share()
+        d.dist = d.key_store.load_dist_public()
+        await d.start_beacon(catchup=True)
+        return d
+
+    async def _start_listeners(self) -> None:
+        tls = None
+        if not self.cfg.insecure:
+            if not (self.cfg.tls_cert and self.cfg.tls_key):
+                raise ValueError("TLS requires tls_cert and tls_key")
+            tls = (self.cfg.tls_cert, self.cfg.tls_key)
+        server = build_public_server(self, self.cfg.listen_addr, tls=tls)
+        await server.start()
+        self._servers.append(server)
+        control = build_control_server(self, self.cfg.control_port)
+        await control.start()
+        self._servers.append(control)
+        if self.cfg.rest_port is not None:
+            from drand_tpu.net.rest import build_rest_app, start_rest
+
+            runner = await start_rest(
+                build_rest_app(self), self.cfg.rest_port
+            )
+            self._servers.append(runner)
+
+    async def stop(self) -> None:
+        if self.beacon is not None:
+            await self.beacon.stop()
+        for s in self._servers:
+            if hasattr(s, "stop"):
+                await s.stop(grace=0.1)
+            else:  # aiohttp runner
+                await s.cleanup()
+        await self._client.close()
+        self._exit.set()
+
+    def request_shutdown(self) -> None:
+        asyncio.get_event_loop().create_task(self.stop())
+
+    async def wait_exit(self) -> None:
+        await self._exit.wait()
+
+    # ------------------------------------------------------------ DKG (ctrl)
+
+    def _check_group(self, group: Group) -> None:
+        if len(group) < MIN_GROUP_SIZE:
+            raise ValueError(
+                f"group too small: {len(group)} < {MIN_GROUP_SIZE}"
+            )
+        if not group.contains(self.pair.public):
+            raise ValueError("this node is not in the group")
+
+    async def init_dkg(self, group_toml: str, is_leader: bool,
+                       timeout: Optional[float] = None,
+                       entropy: Optional[bytes] = None) -> str:
+        """Control-plane fresh DKG (reference InitDKG
+        core/drand_control.go:27-85)."""
+        import tomllib
+
+        group = Group.from_dict(tomllib.loads(group_toml))
+        self._check_group(group)
+        if group.genesis_time <= self.clock.now():
+            raise ValueError("genesis time must be in the future")
+        self._dkg_group = group
+        self._client.dkg_context = (False, group.hash())
+        cfg = DKGConfig(
+            pair=self.pair,
+            new_group=group,
+            timeout=timeout or self.cfg.dkg_timeout,
+            clock=self.clock,
+            entropy=entropy,
+        )
+        self.dkg = DKGHandler(cfg, self._client)
+        if is_leader:
+            await self.dkg.start()
+        else:
+            self.dkg._arm_timer()
+        share = await self.dkg.wait_share()
+        return await self._finish_dkg(group, share)
+
+    async def _finish_dkg(self, group: Group,
+                          share: Optional[Share]) -> str:
+        """Persist DKG output and start the beacon (reference WaitDKG
+        core/drand.go:150-188)."""
+        self.dkg = None
+        self._dkg_group = None
+        if share is None:
+            # old-only node in a reshare: retire at the transition round
+            return ""
+        self.group = group
+        self.share = share
+        self.dist = share.public()
+        self.key_store.save_group(group)
+        self.key_store.save_share(share)
+        self.key_store.save_dist_public(self.dist)
+        await self.start_beacon(catchup=False)
+        return ref.g1_to_bytes(self.dist.key()).hex()
+
+    async def init_reshare(self, new_group_toml: str, is_leader: bool,
+                           old_group_toml: Optional[str] = None,
+                           timeout: Optional[float] = None) -> str:
+        """Control-plane resharing (reference InitReshare
+        core/drand_control.go:91-205): same collective key and chain, new
+        membership/threshold, beacon handover at the transition round."""
+        import tomllib
+
+        if old_group_toml:
+            old_group = Group.from_dict(tomllib.loads(old_group_toml))
+        else:
+            old_group = self.group or self.key_store.load_group()
+        if old_group is None:
+            raise ValueError("no old group for resharing")
+        new_group = Group.from_dict(tomllib.loads(new_group_toml))
+        if len(new_group) < MIN_GROUP_SIZE:
+            raise ValueError("new group too small")
+        # chain continuity requirements (reference :111-151)
+        if new_group.genesis_time != old_group.genesis_time:
+            raise ValueError("genesis time must be preserved")
+        if new_group.period != old_group.period:
+            raise ValueError("period change during resharing not supported")
+        new_group.genesis_seed = old_group.get_genesis_seed()
+        if new_group.transition_time <= self.clock.now():
+            raise ValueError("transition time must be in the future")
+
+        in_old = old_group.contains(self.pair.public)
+        in_new = new_group.contains(self.pair.public)
+        if not in_old and not in_new:
+            raise ValueError("node is in neither old nor new group")
+        old_share = self.share if in_old else None
+
+        self._dkg_group = new_group
+        self._client.dkg_context = (True, new_group.hash())
+        cfg = DKGConfig(
+            pair=self.pair,
+            new_group=new_group,
+            old_group=old_group,
+            old_share=old_share,
+            timeout=timeout or self.cfg.dkg_timeout,
+            clock=self.clock,
+        )
+        self.dkg = DKGHandler(cfg, self._client)
+        if is_leader:
+            await self.dkg.start()
+        else:
+            self.dkg._arm_timer()
+        share = await self.dkg.wait_share()
+        return await self._finish_reshare(
+            old_group, new_group, share, in_new
+        )
+
+    async def _finish_reshare(self, old_group: Group, new_group: Group,
+                              share: Optional[Share],
+                              in_new: bool) -> str:
+        """Beacon handover (reference transition core/drand.go:234-289)."""
+        self.dkg = None
+        self._dkg_group = None
+        transition_round = current_round(
+            new_group.transition_time + 0.001,
+            new_group.period, new_group.genesis_time,
+        )
+        if not in_new:
+            # retiring node: stop producing just before the transition
+            if self.beacon is not None:
+                self.beacon.stop_at(transition_round - 1)
+            return ""
+        assert share is not None
+        old_beacon = self.beacon
+        self.group = new_group
+        self.share = share
+        self.dist = share.public()
+        self.key_store.save_group(new_group)
+        self.key_store.save_share(share)
+        self.key_store.save_dist_public(self.dist)
+        if old_beacon is not None:
+            # existing member: same store, swap handler at transition
+            await old_beacon.stop()
+            await self.start_beacon(catchup=False, transition=True,
+                                    sync_peers=old_group.nodes)
+        else:
+            # brand-new member: sync the old chain then join
+            await self.start_beacon(catchup=False, transition=True,
+                                    sync_peers=old_group.nodes)
+        return ref.g1_to_bytes(self.dist.key()).hex()
+
+    # --------------------------------------------------------------- beacon
+
+    def _beacon_store_path(self) -> str:
+        if self.cfg.in_memory:
+            return ":memory:"
+        base = Path(os.path.expanduser(self.cfg.base_folder)) / "db"
+        base.mkdir(parents=True, exist_ok=True)
+        return str(base / "beacon.db")
+
+    async def start_beacon(self, catchup: bool,
+                           transition: bool = False,
+                           sync_peers: Optional[List[Identity]] = None
+                           ) -> None:
+        assert self.group is not None and self.share is not None
+        public = self._self_identity()
+        bcfg = BeaconConfig(
+            group=self.group,
+            public=public,
+            share=self.share,
+            scheme=self.scheme,
+            clock=self.clock,
+        )
+        # the chain store survives handler swaps (resharing must keep the
+        # already-produced chain, especially for in-memory stores)
+        if self._beacon_store is None:
+            self._beacon_store = BeaconStore(self._beacon_store_path())
+        self.beacon = BeaconHandler(bcfg, self._beacon_store, self._client)
+        self.beacon.add_callback(self._fanout_beacon)
+        if transition:
+            await self.beacon.transition_with_peers(
+                sync_peers or self.group.nodes
+            )
+        elif catchup:
+            await self.beacon.catchup()
+        else:
+            await self.beacon.start()
+
+    def _self_identity(self) -> Identity:
+        """Our identity as listed in the group (the group's Key/addr is
+        canonical; ports may differ from the bind address)."""
+        assert self.group is not None
+        idx = self.group.index(self.pair.public)
+        if idx is None:
+            for i, n in enumerate(self.group.nodes):
+                if n.key == self.pair.public.key:
+                    return n
+            raise ValueError("node missing from group")
+        return self.group.nodes[idx]
+
+    def _fanout_beacon(self, b: Beacon) -> None:
+        for q in list(self._subscribers):
+            try:
+                q.put_nowait(b)
+            except asyncio.QueueFull:
+                pass
+
+    # --------------------------------------- service facade (net/transport)
+
+    def fetch_public_rand(self, round: int) -> Beacon:
+        if self.beacon is None:
+            raise KeyError("beacon not running")
+        b = (self.beacon.store.last() if round == 0
+             else self.beacon.store.get(round))
+        if b is None:
+            raise KeyError(f"no beacon for round {round}")
+        return b
+
+    def subscribe_beacons(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=64)
+        self._subscribers.add(q)
+        return q
+
+    def unsubscribe_beacons(self, q: asyncio.Queue) -> None:
+        self._subscribers.discard(q)
+
+    def serve_private_rand(self, blob: bytes) -> bytes:
+        """ECIES round-trip: decrypt the requester's ephemeral public key,
+        reply with 32 fresh random bytes encrypted to it (reference
+        core/drand_public.go:132-157)."""
+        plain = ecies.decrypt(self.pair.private, blob)
+        eph_pub = ref.g1_from_bytes(plain)
+        if eph_pub is None:
+            raise ValueError("identity ephemeral key")
+        return ecies.encrypt(eph_pub, secrets.token_bytes(32))
+
+    def group_toml(self) -> Optional[str]:
+        g = self.group or self._dkg_group
+        if g is None:
+            try:
+                g = self.key_store.load_group()
+            except KeyNotFound:
+                return None
+        return toml_dumps(g.to_dict())
+
+    def home_status(self) -> str:
+        state = "running" if self.beacon is not None else "waiting for DKG"
+        return f"drand_tpu node {self.pair.public.address} ({state})"
+
+    async def process_beacon_packet(self, packet: BeaconPacket) -> None:
+        if self.beacon is None:
+            raise ValueError("beacon not running")
+        await self.beacon.process_beacon(packet)
+
+    def serve_sync_chain(self, from_round: int) -> List[Beacon]:
+        if self.beacon is None:
+            return []
+        return self.beacon.sync_chain_from(from_round)
+
+    async def process_dkg_packet(self, payload: dict, reshare: bool,
+                                 group_hash: bytes) -> None:
+        """Inbound Setup/Reshare packet.  The group-hash gate mirrors
+        core/drand_public.go:41-43; a first packet reaching a node whose
+        operator already ran init_dkg/init_reshare triggers its dealing
+        (the reference's :45-49 behavior lives in DKGHandler.process)."""
+        if self.dkg is None:
+            raise ValueError("no DKG in progress on this node")
+        expected = self._dkg_group.hash() if self._dkg_group else b""
+        if group_hash and expected and group_hash != expected:
+            raise ValueError("group hash mismatch")
+        await self.dkg.process(payload)
+
+    # ------------------------------------------------------- control facade
+
+    def share_info(self):
+        share = self.share or self.key_store.load_share()
+        return share.share.index, share.share.value.to_bytes(32, "big").hex()
+
+    def public_key_hex(self) -> str:
+        return self.pair.public.key_hex
+
+    def private_key_hex(self) -> str:
+        return self.pair.private.to_bytes(32, "big").hex()
+
+    def collective_key_hex(self) -> List[str]:
+        dist = self.dist or self.key_store.load_dist_public()
+        return [ref.g1_to_bytes(c).hex() for c in dist.coefficients]

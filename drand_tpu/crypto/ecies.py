@@ -1,0 +1,69 @@
+"""ECIES: ephemeral-static DH on G1 -> HKDF-SHA256 -> AES-256-GCM.
+
+Mirrors /root/reference/ecies/ecies.go (Encrypt :28-79, Decrypt :84-119).
+Used for (a) the private-randomness API and (b) encrypting DKG deal shares
+to their recipients.
+
+Wire format: 48-byte compressed ephemeral G1 point || 12-byte nonce ||
+ciphertext+tag.
+"""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+from cryptography.hazmat.primitives import hashes
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto.poly import rand_scalar
+
+NONCE_LEN = 12
+KEY_LEN = 32
+
+
+class EciesError(Exception):
+    pass
+
+
+def _derive_key(shared_point) -> bytes:
+    return HKDF(
+        algorithm=hashes.SHA256(),
+        length=KEY_LEN,
+        salt=None,
+        info=b"drand-tpu-ecies-v1",
+    ).derive(ref.g1_to_bytes(shared_point))
+
+
+def encrypt(recipient_pub, plaintext: bytes,
+            associated_data: bytes = b"") -> bytes:
+    """Encrypt to a G1 public key."""
+    eph = rand_scalar()
+    r_point = ref.g1_mul(ref.G1_GEN, eph)
+    shared = ref.g1_mul(recipient_pub, eph)
+    key = _derive_key(shared)
+    nonce = os.urandom(NONCE_LEN)
+    ct = AESGCM(key).encrypt(nonce, plaintext, associated_data or None)
+    return ref.g1_to_bytes(r_point) + nonce + ct
+
+
+def decrypt(private_scalar: int, blob: bytes,
+            associated_data: bytes = b"") -> bytes:
+    """Decrypt with the recipient's secret scalar."""
+    if len(blob) < 48 + NONCE_LEN + 16:
+        raise EciesError("ciphertext too short")
+    try:
+        r_point = ref.g1_from_bytes(blob[:48])
+    except ValueError as exc:
+        raise EciesError(f"bad ephemeral point: {exc}") from exc
+    if r_point is None:
+        raise EciesError("identity ephemeral point rejected")
+    nonce = blob[48 : 48 + NONCE_LEN]
+    ct = blob[48 + NONCE_LEN :]
+    shared = ref.g1_mul(r_point, private_scalar)
+    key = _derive_key(shared)
+    try:
+        return AESGCM(key).decrypt(nonce, ct, associated_data or None)
+    except Exception as exc:
+        raise EciesError("decryption failed") from exc

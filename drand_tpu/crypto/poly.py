@@ -1,0 +1,158 @@
+"""Secret-sharing polynomials over the BLS12-381 scalar field (host side).
+
+Equivalent of kyber's ``share/poly`` module, which the reference uses for
+DKG shares and threshold recovery (`share.PriShare`/`share.PubPoly`,
+/root/reference/key/keys.go:164-175).  Scalar arithmetic is plain python
+ints mod r — committee sizes are <= ~1000, so this is never a hot path;
+the hot exponentiations/MSMs live on the device.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from drand_tpu.crypto import refimpl as ref
+
+R = ref.R
+
+
+def rand_scalar(rng: Optional[Callable[[int], bytes]] = None) -> int:
+    """Uniform nonzero scalar; rng(nbytes) may inject external entropy."""
+    reader = rng or secrets.token_bytes
+    while True:
+        v = int.from_bytes(reader(48), "big") % R
+        if v != 0:
+            return v
+
+
+@dataclass(frozen=True)
+class PriShare:
+    """One private share: the polynomial evaluated at x = index + 1."""
+
+    index: int
+    value: int
+
+
+class PriPoly:
+    """Secret-sharing polynomial f of degree t-1 with f(0) = secret."""
+
+    def __init__(self, coeffs: Sequence[int]):
+        assert len(coeffs) >= 1
+        self.coeffs = [c % R for c in coeffs]
+
+    @classmethod
+    def random(cls, t: int, secret: Optional[int] = None,
+               rng: Optional[Callable[[int], bytes]] = None) -> "PriPoly":
+        coeffs = [rand_scalar(rng) for _ in range(t)]
+        if secret is not None:
+            coeffs[0] = secret % R
+        return cls(coeffs)
+
+    @property
+    def threshold(self) -> int:
+        return len(self.coeffs)
+
+    def secret(self) -> int:
+        return self.coeffs[0]
+
+    def eval(self, index: int) -> PriShare:
+        x = index + 1  # x = 0 is the secret; shares start at 1
+        acc = 0
+        for c in reversed(self.coeffs):
+            acc = (acc * x + c) % R
+        return PriShare(index, acc)
+
+    def shares(self, n: int) -> List[PriShare]:
+        return [self.eval(i) for i in range(n)]
+
+    def add(self, other: "PriPoly") -> "PriPoly":
+        assert self.threshold == other.threshold
+        return PriPoly([
+            (a + b) % R for a, b in zip(self.coeffs, other.coeffs)
+        ])
+
+    def commit(self, base=None) -> "PubPoly":
+        base = base if base is not None else ref.G1_GEN
+        return PubPoly(
+            [ref.g1_mul(base, c) for c in self.coeffs], base=base
+        )
+
+
+class PubPoly:
+    """Public commitments F_j = base^{a_j} to a PriPoly's coefficients."""
+
+    def __init__(self, commits: Sequence, base=None):
+        self.commits = list(commits)
+        self.base = base if base is not None else ref.G1_GEN
+
+    @property
+    def threshold(self) -> int:
+        return len(self.commits)
+
+    def commit(self):
+        """The committed secret: base^{f(0)} — the distributed public key."""
+        return self.commits[0]
+
+    def eval(self, index: int):
+        """base^{f(index+1)} via Horner in the exponent."""
+        x = index + 1
+        acc = None
+        for c in reversed(self.commits):
+            acc = ref.g1_add(ref.g1_mul(acc, x), c)
+        return acc
+
+    def add(self, other: "PubPoly") -> "PubPoly":
+        assert self.threshold == other.threshold
+        return PubPoly(
+            [ref.g1_add(a, b)
+             for a, b in zip(self.commits, other.commits)],
+            base=self.base,
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, PubPoly)
+            and self.base == other.base
+            and self.commits == other.commits
+        )
+
+
+def lagrange_basis_at_zero(indices: Sequence[int]) -> Dict[int, int]:
+    """lambda_i such that f(0) = sum_i lambda_i f(x_i), x_i = index + 1."""
+    lambdas: Dict[int, int] = {}
+    xs = [(i, i + 1) for i in indices]
+    for i, xi in xs:
+        num, den = 1, 1
+        for j, xj in xs:
+            if j == i:
+                continue
+            num = num * xj % R
+            den = den * (xj - xi) % R
+        lambdas[i] = num * pow(den, -1, R) % R
+    return lambdas
+
+
+def recover_secret(shares: Sequence[PriShare], t: int) -> int:
+    """Lagrange-interpolate f(0) from any t shares (kyber RecoverSecret)."""
+    if len(shares) < t:
+        raise ValueError(f"need {t} shares, have {len(shares)}")
+    use = list(shares)[:t]
+    lam = lagrange_basis_at_zero([s.index for s in use])
+    return sum(lam[s.index] * s.value for s in use) % R
+
+
+def recover_commit_g2(points: Sequence[Tuple[int, object]], t: int):
+    """Lagrange-combine G2 group elements (oracle path; device uses MSM).
+
+    points: sequence of (index, G2 point).  Returns sum lambda_i * P_i.
+    """
+    if len(points) < t:
+        raise ValueError(f"need {t} points, have {len(points)}")
+    use = list(points)[:t]
+    lam = lagrange_basis_at_zero([i for i, _ in use])
+    acc = None
+    for i, pt in use:
+        acc = ref.g2_add(acc, ref.g2_mul(pt, lam[i]))
+    return acc

@@ -1,0 +1,14 @@
+"""Distributed key generation (Pedersen) — fresh and resharing modes.
+
+Equivalent of /root/reference/dkg/ (which wraps kyber's dkg/pedersen):
+:mod:`pedersen` is the pure cryptographic state machine,
+:mod:`handler` the network protocol around it (leader sends deals,
+responses broadcast, threshold certification on timeout)."""
+
+from drand_tpu.dkg.pedersen import (  # noqa: F401
+    Deal,
+    DistKeyGenerator,
+    DKGError,
+    Response,
+)
+from drand_tpu.dkg.handler import DKGConfig, DKGHandler  # noqa: F401

@@ -1,0 +1,216 @@
+"""DKG network protocol: deals out, responses broadcast, timeout certify.
+
+Mirrors /root/reference/dkg/dkg.go behavior:
+* the leader starts by sending deals (`Start` :183 -> `sendDeals` :431);
+  every other dealer sends its own deals upon first contact (:164-182);
+* deals go to new-group members only, responses are broadcast to both old
+  and new groups (:495-499);
+* full certification finalizes immediately; otherwise a timer fires and
+  threshold certification is accepted (`startTimer` :236-252,
+  `checkCertified` :383-426);
+* `wait_share()` resolves with the final Share (or None for old-only
+  nodes in a reshare), `wait_error()` with a failure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from drand_tpu.dkg.pedersen import (
+    Deal,
+    DistKeyGenerator,
+    DKGError,
+    Response,
+)
+from drand_tpu.key import Group, Identity, Pair, Share
+from drand_tpu.utils.clock import Clock
+
+log = logging.getLogger("drand_tpu.dkg")
+
+DEFAULT_TIMEOUT = 60.0  # reference core/constants.go:34
+
+
+class DKGNetwork:
+    """Outbound transport for DKG packets."""
+
+    async def send_dkg(self, peer: Identity, packet: dict) -> None:
+        raise NotImplementedError
+
+
+@dataclass
+class DKGConfig:
+    pair: Pair
+    new_group: Group
+    old_group: Optional[Group] = None          # reshare only
+    old_share: Optional[Share] = None          # reshare, old nodes only
+    timeout: float = DEFAULT_TIMEOUT
+    clock: Clock = field(default_factory=Clock)
+    entropy: Optional[bytes] = None
+
+
+class DKGHandler:
+    def __init__(self, cfg: DKGConfig, net: DKGNetwork):
+        self.cfg = cfg
+        self.net = net
+        old_group = cfg.old_group
+        old_commits = None
+        if old_group is not None and cfg.old_share is not None:
+            old_commits = cfg.old_share.commits
+        self.dkg = DistKeyGenerator(
+            pair=cfg.pair,
+            participants=cfg.new_group.nodes,
+            threshold=cfg.new_group.threshold,
+            old_participants=old_group.nodes if old_group else None,
+            old_share=cfg.old_share,
+            old_threshold=old_group.threshold if old_group else None,
+            old_dist_commits=old_commits,
+            entropy=cfg.entropy,
+        )
+        self._sent_deals = False
+        self._done = False
+        self._share_fut: asyncio.Future = (
+            asyncio.get_event_loop().create_future()
+        )
+        self._timer_task: Optional[asyncio.Task] = None
+        self._lock = asyncio.Lock()
+
+    # -- control ----------------------------------------------------------
+
+    async def start(self) -> None:
+        """Leader entry point: send deals and arm the timeout."""
+        self._arm_timer()
+        await self._send_deals()
+
+    def wait_share(self) -> asyncio.Future:
+        return self._share_fut
+
+    # -- outbound ---------------------------------------------------------
+
+    async def _send_deals(self) -> None:
+        async with self._lock:
+            if self._sent_deals or not self.dkg.is_dealer:
+                return
+            self._sent_deals = True
+        deals = self.dkg.deals()
+        for deal in deals:
+            target = self.cfg.new_group.nodes[deal.recipient_index]
+            if self._is_self(target):
+                resp = self.dkg.process_deal(deal)
+                await self._broadcast_response(resp)
+            else:
+                await self._send(
+                    target, {"dkg_deal": deal.to_dict()}
+                )
+
+    async def _broadcast_response(self, resp: Response) -> None:
+        packet = {"dkg_response": resp.to_dict()}
+        for node in self._all_nodes():
+            if self._is_self(node):
+                continue
+            await self._send(node, packet)
+        self._check_done()
+
+    def _all_nodes(self) -> List[Identity]:
+        nodes = list(self.cfg.new_group.nodes)
+        if self.cfg.old_group is not None:
+            seen = {(n.address, n.key) for n in nodes}
+            for n in self.cfg.old_group.nodes:
+                if (n.address, n.key) not in seen:
+                    nodes.append(n)
+        return nodes
+
+    def _is_self(self, node: Identity) -> bool:
+        return (node.address == self.cfg.pair.public.address
+                and node.key == self.cfg.pair.public.key)
+
+    async def _send(self, peer: Identity, packet: dict) -> None:
+        """Fire-and-forget (the reference uses a goroutine per send,
+        dkg/dkg.go:452-473): awaiting peers inline would nest RPC chains
+        across nodes and deadlock the mesh."""
+
+        async def _go():
+            try:
+                await self.net.send_dkg(peer, packet)
+            except Exception as exc:
+                log.debug("dkg send to %s failed: %s", peer.address, exc)
+
+        asyncio.create_task(_go())
+
+    # -- inbound ----------------------------------------------------------
+
+    async def process(self, packet: dict) -> None:
+        """Inbound DKG packet (reference Process dkg/dkg.go:164)."""
+        if self._done:
+            return
+        if "dkg_deal" in packet:
+            # first contact triggers our own dealing (non-leader path)
+            self._arm_timer()
+            await self._send_deals()
+            deal = Deal.from_dict(packet["dkg_deal"])
+            try:
+                resp = self.dkg.process_deal(deal)
+            except DKGError as exc:
+                log.warning("bad deal: %s", exc)
+                return
+            await self._broadcast_response(resp)
+        elif "dkg_response" in packet:
+            try:
+                self.dkg.process_response(
+                    Response.from_dict(packet["dkg_response"])
+                )
+            except DKGError as exc:
+                log.warning("bad response: %s", exc)
+                return
+            self._check_done()
+
+    # -- certification ----------------------------------------------------
+
+    def _arm_timer(self) -> None:
+        if self._timer_task is None:
+            self._timer_task = asyncio.create_task(self._timer())
+
+    async def _timer(self) -> None:
+        await self.cfg.clock.sleep(self.cfg.timeout)
+        if self._done:
+            return
+        if self.dkg.threshold_certified():
+            log.info("dkg timeout: accepting threshold certification")
+            self._finalize()
+        else:
+            self._fail(DKGError(
+                "dkg timed out without threshold certification"
+            ))
+
+    def _check_done(self) -> None:
+        if not self._done and self.dkg.certified():
+            self._finalize()
+
+    def _finalize(self) -> None:
+        self._done = True
+        if self._timer_task is not None:
+            self._timer_task.cancel()
+        try:
+            if self.dkg.index is None:
+                # old-only node in a reshare: participates as dealer but
+                # gets no share in the new group
+                result = None
+            else:
+                result = self.dkg.dist_key_share()
+        except DKGError as exc:
+            self._fail(exc)
+            return
+        if not self._share_fut.done():
+            self._share_fut.set_result(result)
+
+    def _fail(self, exc: Exception) -> None:
+        self._done = True
+        if not self._share_fut.done():
+            self._share_fut.set_exception(exc)
+
+    def qualified_group(self) -> Group:
+        """The new group (QUAL applies to dealers; new membership is the
+        configured new group — reference QualifiedGroup dkg/dkg.go:222)."""
+        return self.cfg.new_group

@@ -1,0 +1,319 @@
+"""Pedersen DKG state machine (pure crypto, no networking).
+
+The math mirrors kyber `dkg/pedersen` as consumed by the reference
+(/root/reference/dkg/dkg.go:62,115):
+
+* every dealer d samples a secret polynomial g_d of degree t-1 (fresh mode:
+  random secret; reshare mode: g_d(0) = d's existing share value), commits
+  to its coefficients in G1, and sends participant j the evaluation
+  g_d(j+1) encrypted to j's long-term key (ECIES);
+* each participant verifies every received sub-share against the dealer's
+  commitments (G^s == sum_k C_{d,k} (j+1)^k) and broadcasts an
+  approve/complaint response;
+* a dealer is *certified* once at least t participants approved it; the
+  qualified set QUAL is the certified dealers;
+* final share for j:  sum_{d in QUAL} w_d * s_{d,j}, where w_d = 1 in
+  fresh mode and the Lagrange weight at zero of d's old index in reshare
+  mode — so the collective secret (and hence the distributed public key
+  and the beacon chain) is preserved across resharing;
+* final commitments: coefficient-wise  sum_{d in QUAL} w_d * C_{d,k}.
+
+Complaint handling is exclusion-based: a dealer that fails to reach t
+approvals is simply left out of QUAL (the reference's timeout path
+dkg/dkg.go:383-426 behaves the same for non-answering dealers; kyber's
+justification round-trip is not reproduced).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from drand_tpu.crypto import ecies
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto.poly import (
+    PriPoly,
+    PriShare,
+    lagrange_basis_at_zero,
+)
+from drand_tpu.key import Identity, Pair, Share
+
+
+class DKGError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Deal:
+    dealer_index: int
+    recipient_index: int
+    commits_bytes: tuple          # tuple of 48-byte G1 commitments
+    encrypted_share: bytes
+
+    def commits(self) -> List[tuple]:
+        return [ref.g1_from_bytes(b) for b in self.commits_bytes]
+
+    def to_dict(self) -> dict:
+        return {
+            "dealer_index": self.dealer_index,
+            "recipient_index": self.recipient_index,
+            "commits": [b.hex() for b in self.commits_bytes],
+            "encrypted_share": self.encrypted_share.hex(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Deal":
+        return cls(
+            dealer_index=int(d["dealer_index"]),
+            recipient_index=int(d["recipient_index"]),
+            commits_bytes=tuple(bytes.fromhex(h) for h in d["commits"]),
+            encrypted_share=bytes.fromhex(d["encrypted_share"]),
+        )
+
+
+@dataclass(frozen=True)
+class Response:
+    dealer_index: int
+    verifier_index: int
+    approved: bool
+
+    def to_dict(self) -> dict:
+        return {
+            "dealer_index": self.dealer_index,
+            "verifier_index": self.verifier_index,
+            "approved": self.approved,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Response":
+        return cls(
+            dealer_index=int(d["dealer_index"]),
+            verifier_index=int(d["verifier_index"]),
+            approved=bool(d["approved"]),
+        )
+
+
+class DistKeyGenerator:
+    """One participant's DKG state.
+
+    fresh:    participants = the group; every participant deals.
+    reshare:  dealers = the old group (must supply old_share); share
+              verification/aggregation uses Lagrange weights over old
+              indices so the collective key is unchanged.
+    """
+
+    def __init__(
+        self,
+        pair: Pair,
+        participants: Sequence[Identity],
+        threshold: int,
+        old_participants: Optional[Sequence[Identity]] = None,
+        old_share: Optional[Share] = None,
+        old_threshold: Optional[int] = None,
+        old_dist_commits: Optional[Sequence[tuple]] = None,
+        entropy: Optional[bytes] = None,
+    ):
+        self.pair = pair
+        self.participants = list(participants)
+        self.threshold = threshold
+        self.reshare = old_participants is not None
+        self.old_participants = list(old_participants or participants)
+        self.old_threshold = old_threshold or threshold
+        #: reshare only: the old collective commitments, used to check each
+        #: dealer actually re-shares its existing share (C_{d,0} must equal
+        #: the old public polynomial evaluated at the dealer's index)
+        self.old_dist_commits = (
+            list(old_dist_commits) if old_dist_commits else None
+        )
+
+        self.index = self._find_index(self.participants, pair.public)
+        self.dealer_index = self._find_index(
+            self.old_participants, pair.public
+        )
+        if self.index is None and self.dealer_index is None:
+            raise DKGError("not a participant of this DKG")
+        self.is_dealer = self.dealer_index is not None
+
+        self._poly: Optional[PriPoly] = None
+        if self.is_dealer:
+            secret = None
+            if self.reshare:
+                if old_share is None:
+                    raise DKGError("resharing requires the old share")
+                secret = old_share.share.value
+            rng = None
+            if entropy:
+                rng = _entropy_rng(entropy)
+            self._poly = PriPoly.random(threshold, secret=secret, rng=rng)
+            self._commits = [
+                ref.g1_to_bytes(c) for c in self._poly.commit().commits
+            ]
+
+        # receiving state
+        self._received: Dict[int, PriShare] = {}      # dealer -> sub-share
+        self._commits_seen: Dict[int, tuple] = {}     # dealer -> commits
+        self._approvals: Dict[int, set] = {}          # dealer -> verifiers
+        self._complaints: Dict[int, set] = {}
+
+    @staticmethod
+    def _find_index(nodes: Sequence[Identity],
+                    me: Identity) -> Optional[int]:
+        for i, n in enumerate(nodes):
+            if n.address == me.address and n.key == me.key:
+                return i
+        return None
+
+    # -- dealing ----------------------------------------------------------
+
+    def deals(self) -> List[Deal]:
+        """Encrypted deals, one per participant (self-deal processed
+        directly by the caller via process_deal)."""
+        if not self.is_dealer:
+            raise DKGError("not a dealer in this DKG")
+        out = []
+        for j, node in enumerate(self.participants):
+            share = self._poly.eval(j)
+            blob = share.value.to_bytes(32, "big")
+            enc = ecies.encrypt(node.key, blob,
+                                associated_data=self._ad(j))
+            out.append(
+                Deal(
+                    dealer_index=self.dealer_index,
+                    recipient_index=j,
+                    commits_bytes=tuple(self._commits),
+                    encrypted_share=enc,
+                )
+            )
+        return out
+
+    def _ad(self, recipient_index: int) -> bytes:
+        return b"drand-tpu-dkg-deal-%d" % recipient_index
+
+    # -- processing -------------------------------------------------------
+
+    def process_deal(self, deal: Deal) -> Response:
+        """Verify a deal addressed to us; produce our response."""
+        if self.index is None:
+            raise DKGError("only group members process deals")
+        if deal.recipient_index != self.index:
+            raise DKGError("deal not addressed to this node")
+        d = deal.dealer_index
+        if not (0 <= d < len(self.old_participants)):
+            raise DKGError("unknown dealer index")
+        if d in self._received:
+            raise DKGError("duplicate deal")
+        approved = False
+        try:
+            commits = deal.commits()
+            if len(commits) != self.threshold:
+                raise DKGError("bad commitment count")
+            if self.reshare and self.old_dist_commits is not None:
+                expect0 = _eval_commits(self.old_dist_commits, d)
+                if commits[0] != expect0:
+                    raise DKGError("dealer does not re-share its share")
+            blob = ecies.decrypt(
+                self.pair.private, deal.encrypted_share,
+                associated_data=self._ad(self.index),
+            )
+            value = int.from_bytes(blob, "big") % ref.R
+            # G^s must equal the commitment polynomial at our index
+            expect = _eval_commits(commits, self.index)
+            if ref.g1_mul(ref.G1_GEN, value) == expect:
+                self._received[d] = PriShare(self.index, value)
+                self._commits_seen[d] = tuple(commits)
+                approved = True
+        except (ecies.EciesError, ValueError, DKGError):
+            approved = False
+        resp = Response(dealer_index=d, verifier_index=self.index,
+                        approved=approved)
+        self.process_response(resp)
+        return resp
+
+    def process_response(self, resp: Response) -> None:
+        if not (0 <= resp.dealer_index < len(self.old_participants)):
+            raise DKGError("unknown dealer index in response")
+        if not (0 <= resp.verifier_index < len(self.participants)):
+            raise DKGError("unknown verifier index in response")
+        target = (self._approvals if resp.approved
+                  else self._complaints)
+        target.setdefault(resp.dealer_index, set()).add(
+            resp.verifier_index
+        )
+
+    # -- certification ----------------------------------------------------
+
+    def _certified_dealers(self) -> List[int]:
+        out = []
+        for d, verifiers in self._approvals.items():
+            if len(verifiers) >= self.threshold and d in self._received:
+                out.append(d)
+        return sorted(out)
+
+    def certified(self) -> bool:
+        """Fully certified: every dealer approved by every participant."""
+        n = len(self.participants)
+        dealers = range(len(self.old_participants))
+        return all(
+            len(self._approvals.get(d, ())) >= n and d in self._received
+            for d in dealers
+        )
+
+    def threshold_certified(self) -> bool:
+        """Enough certified dealers to fix the collective secret."""
+        need = (self.old_threshold if self.reshare else self.threshold)
+        return len(self._certified_dealers()) >= need
+
+    def qual(self) -> List[int]:
+        return self._certified_dealers()
+
+    # -- finalization -----------------------------------------------------
+
+    def dist_key_share(self) -> Share:
+        if not self.threshold_certified():
+            raise DKGError("not enough certified dealers")
+        qual = self.qual()
+        if self.reshare:
+            weights = lagrange_basis_at_zero(qual)
+        else:
+            weights = {d: 1 for d in qual}
+        value = 0
+        commits = [None] * self.threshold
+        for d in qual:
+            w = weights[d]
+            value = (value + w * self._received[d].value) % ref.R
+            for k, c in enumerate(self._commits_seen[d]):
+                commits[k] = ref.g1_add(commits[k], ref.g1_mul(c, w))
+        return Share(
+            commits=commits,
+            share=PriShare(self.index, value),
+        )
+
+
+def _eval_commits(commits: Sequence[tuple], index: int):
+    """sum_k C_k * (index+1)^k via Horner in the exponent."""
+    x = index + 1
+    acc = None
+    for c in reversed(list(commits)):
+        acc = ref.g1_add(ref.g1_mul(acc, x), c)
+    return acc
+
+
+def _entropy_rng(entropy: bytes):
+    """Deterministic byte stream seeded from user entropy + os randomness
+    (reference mixes user entropy with crypto/rand; dkg/dkg.go:43)."""
+    import hashlib
+    import os
+
+    seed = hashlib.sha256(entropy + os.urandom(32)).digest()
+    counter = [0]
+
+    def read(n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            out += hashlib.sha256(
+                seed + counter[0].to_bytes(8, "big")
+            ).digest()
+            counter[0] += 1
+        return out[:n]
+
+    return read

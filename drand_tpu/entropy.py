@@ -1,0 +1,37 @@
+"""Entropy sources for key generation / DKG.
+
+Mirrors /root/reference/entropy/entropy.go: `GetRandom` reads from a
+user-supplied executable's stdout, falling back to the OS CSPRNG when the
+script fails or returns short output (:15-30); `ScriptReader` wraps the
+exec (:32-67).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional
+
+
+def get_random(n: int, source: Optional[str] = None) -> bytes:
+    """n random bytes from `source` (an executable path) or os.urandom."""
+    if source:
+        try:
+            out = subprocess.run(
+                [source], capture_output=True, timeout=10, check=True
+            ).stdout
+            if len(out) >= n:
+                return out[:n]
+        except (OSError, subprocess.SubprocessError):
+            pass
+    return os.urandom(n)
+
+
+class ScriptReader:
+    """Reader interface over a user executable (DKG user entropy)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def read(self, n: int) -> bytes:
+        return get_random(n, self.path)

@@ -1,0 +1,16 @@
+"""Key material and group model (host side).
+
+Equivalent of the reference's `key/` package: long-term keypairs, node
+identities, DKG share wrappers, the distributed public key, and the group
+descriptor (/root/reference/key/keys.go, key/group.go)."""
+
+from drand_tpu.key.keys import (  # noqa: F401
+    DistPublic,
+    Identity,
+    Pair,
+    Share,
+    default_threshold,
+    minimum_threshold,
+)
+from drand_tpu.key.group import Group  # noqa: F401
+from drand_tpu.key.store import FileStore, MemStore  # noqa: F401

@@ -1,0 +1,110 @@
+"""Group descriptor: node list, threshold, period, genesis.
+
+Mirrors /root/reference/key/group.go: ordered node identities, the signing
+threshold, beacon period, genesis/transition times, and the genesis seed.
+The blake2b group hash pins the exact configuration; the genesis seed (used
+to derive round 0's beacon) defaults to the hash of the group *without* a
+seed (key/group.go:83-102, 201).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.key.keys import Identity, minimum_threshold
+from drand_tpu.utils import format_duration, parse_duration
+
+
+@dataclass
+class Group:
+    nodes: List[Identity]
+    threshold: int
+    period: float = 60.0           # seconds
+    genesis_time: int = 0          # unix seconds
+    transition_time: int = 0       # unix seconds (resharing)
+    genesis_seed: bytes = b""
+
+    def __post_init__(self):
+        n = len(self.nodes)
+        if self.threshold < minimum_threshold(n):
+            raise ValueError(
+                f"threshold {self.threshold} below minimum "
+                f"{minimum_threshold(n)} for {n} nodes"
+            )
+        if self.threshold > n:
+            raise ValueError("threshold larger than group size")
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    def index(self, identity: Identity) -> Optional[int]:
+        for i, node in enumerate(self.nodes):
+            if node.address == identity.address and \
+                    node.key == identity.key:
+                return i
+        return None
+
+    def contains(self, identity: Identity) -> bool:
+        return self.index(identity) is not None
+
+    def public_keys(self) -> List[tuple]:
+        return [n.key for n in self.nodes]
+
+    def hash(self) -> bytes:
+        """blake2b-256 digest over the canonical group description."""
+        h = hashlib.blake2b(digest_size=32)
+        for i, node in enumerate(self.nodes):
+            h.update(i.to_bytes(4, "little"))
+            h.update(ref.g1_to_bytes(node.key))
+        h.update(self.threshold.to_bytes(4, "little"))
+        h.update(int(self.genesis_time).to_bytes(8, "little"))
+        if self.transition_time:
+            h.update(int(self.transition_time).to_bytes(8, "little"))
+        return h.digest()
+
+    def get_genesis_seed(self) -> bytes:
+        """The chain's genesis seed; defaults to the group hash."""
+        if not self.genesis_seed:
+            self.genesis_seed = self.hash()
+        return self.genesis_seed
+
+    # -- TOML ------------------------------------------------------------
+
+    def to_dict(self) -> Dict:
+        d = {
+            "Threshold": self.threshold,
+            "Period": format_duration(self.period),
+            "GenesisTime": int(self.genesis_time),
+            "TransitionTime": int(self.transition_time),
+            "Nodes": [n.to_dict() for n in self.nodes],
+        }
+        if self.genesis_seed:
+            d["GenesisSeed"] = self.genesis_seed.hex()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Group":
+        return cls(
+            nodes=[Identity.from_dict(n) for n in d["Nodes"]],
+            threshold=int(d["Threshold"]),
+            period=parse_duration(d.get("Period", 60.0)),
+            genesis_time=int(d.get("GenesisTime", 0)),
+            transition_time=int(d.get("TransitionTime", 0)),
+            genesis_seed=bytes.fromhex(d["GenesisSeed"])
+            if d.get("GenesisSeed") else b"",
+        )
+
+
+def merge_groups(old_nodes: Sequence[Identity],
+                 new_nodes: Sequence[Identity]) -> List[Identity]:
+    """Union for resharing: new nodes first, then old ones not in new
+    (reference key/group.go:221 MergeGroup)."""
+    seen = {(n.address, n.key) for n in new_nodes}
+    merged = list(new_nodes)
+    for n in old_nodes:
+        if (n.address, n.key) not in seen:
+            merged.append(n)
+    return merged

@@ -1,0 +1,144 @@
+"""Keypairs, identities, shares, distributed public keys.
+
+Mirrors /root/reference/key/keys.go: `Pair` (long-term BLS keypair on G1),
+`Identity` (public key + dial address + TLS flag), `Share` (one node's DKG
+output: public commitments + private share), `DistPublic` (the collective
+key's coefficient commitments).  Encodings: 48-byte compressed G1 hex.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto.poly import PriShare, PubPoly, rand_scalar
+
+
+def default_threshold(n: int) -> int:
+    """floor(n/2) + 1 (reference key/keys.go:367)."""
+    return n // 2 + 1
+
+
+def minimum_threshold(n: int) -> int:
+    """Smallest sound threshold (vss.MinimumT): floor((n+1)/2)."""
+    return (n + 1) // 2
+
+
+@dataclass(frozen=True)
+class Identity:
+    """A node's public identity: G1 key + reachable address (+ TLS)."""
+
+    address: str
+    key: tuple  # affine G1 point
+    tls: bool = False
+
+    @property
+    def key_hex(self) -> str:
+        return ref.g1_to_bytes(self.key).hex()
+
+    def to_dict(self) -> Dict:
+        return {"Address": self.address, "Key": self.key_hex,
+                "TLS": self.tls}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Identity":
+        return cls(
+            address=d["Address"],
+            key=ref.g1_from_bytes(bytes.fromhex(d["Key"])),
+            tls=bool(d.get("TLS", False)),
+        )
+
+
+@dataclass
+class Pair:
+    """Long-term keypair: secret scalar + public identity."""
+
+    private: int
+    public: Identity
+
+    @classmethod
+    def generate(cls, address: str, tls: bool = False,
+                 rng=None) -> "Pair":
+        sk = rand_scalar(rng)
+        pk = ref.g1_mul(ref.G1_GEN, sk)
+        return cls(private=sk, public=Identity(address, pk, tls))
+
+    def to_dict(self) -> Dict:
+        return {
+            "Key": self.private.to_bytes(32, "big").hex(),
+            "Public": self.public.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Pair":
+        return cls(
+            private=int.from_bytes(bytes.fromhex(d["Key"]), "big"),
+            public=Identity.from_dict(d["Public"]),
+        )
+
+
+@dataclass
+class DistPublic:
+    """Distributed public key: commitments to the collective polynomial."""
+
+    coefficients: List[tuple]
+
+    def key(self) -> tuple:
+        """The collective public key (coefficient 0)."""
+        return self.coefficients[0]
+
+    def pub_poly(self) -> PubPoly:
+        return PubPoly(self.coefficients)
+
+    def to_dict(self) -> Dict:
+        return {
+            "Coefficients": [
+                ref.g1_to_bytes(c).hex() for c in self.coefficients
+            ]
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "DistPublic":
+        return cls(
+            coefficients=[
+                ref.g1_from_bytes(bytes.fromhex(h))
+                for h in d["Coefficients"]
+            ]
+        )
+
+    def equal(self, other: "DistPublic") -> bool:
+        return self.coefficients == other.coefficients
+
+
+@dataclass
+class Share:
+    """One node's DKG result: commitments + its private share."""
+
+    commits: List[tuple]
+    share: PriShare
+
+    def public(self) -> DistPublic:
+        return DistPublic(list(self.commits))
+
+    def pub_poly(self) -> PubPoly:
+        return PubPoly(list(self.commits))
+
+    def to_dict(self) -> Dict:
+        return {
+            "Commits": [ref.g1_to_bytes(c).hex() for c in self.commits],
+            "Index": self.share.index,
+            "Share": self.share.value.to_bytes(32, "big").hex(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Share":
+        return cls(
+            commits=[
+                ref.g1_from_bytes(bytes.fromhex(h)) for h in d["Commits"]
+            ],
+            share=PriShare(
+                index=int(d["Index"]),
+                value=int.from_bytes(bytes.fromhex(d["Share"]), "big"),
+            ),
+        )

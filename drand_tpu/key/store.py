@@ -1,0 +1,130 @@
+"""Durable key-material store (TOML files, restrictive permissions).
+
+Mirrors /root/reference/key/store.go: a file store rooted at the node's
+base folder, with `key/` (0700) holding the private material and `groups/`
+(0740) the shared descriptors.  Everything is TOML: write with the minimal
+serializer, read with stdlib tomllib.  A MemStore mirrors the reference's
+test key store (/root/reference/test/key_store.go).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from pathlib import Path
+from typing import Optional
+
+from drand_tpu.key.group import Group
+from drand_tpu.key.keys import DistPublic, Pair, Share
+from drand_tpu.utils import toml_dumps
+
+KEY_FOLDER = "key"
+GROUP_FOLDER = "groups"
+PAIR_FILE = "drand_id.toml"
+SHARE_FILE = "dist_key.private.toml"
+DIST_FILE = "dist_key.public.toml"
+GROUP_FILE = "drand_group.toml"
+
+
+class KeyNotFound(Exception):
+    pass
+
+
+class FileStore:
+    def __init__(self, base_dir: str):
+        self.base = Path(base_dir)
+        self.key_dir = self.base / KEY_FOLDER
+        self.group_dir = self.base / GROUP_FOLDER
+        self.key_dir.mkdir(parents=True, exist_ok=True)
+        self.group_dir.mkdir(parents=True, exist_ok=True)
+        os.chmod(self.base, 0o740)
+        os.chmod(self.key_dir, 0o700)
+        os.chmod(self.group_dir, 0o740)
+
+    # -- private write helper --------------------------------------------
+
+    def _write(self, path: Path, data: dict, mode: int) -> None:
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(toml_dumps(data))
+        os.chmod(tmp, mode)
+        tmp.replace(path)
+
+    def _read(self, path: Path) -> dict:
+        if not path.exists():
+            raise KeyNotFound(str(path))
+        with open(path, "rb") as fh:
+            return tomllib.load(fh)
+
+    # -- keypair ----------------------------------------------------------
+
+    def save_key_pair(self, pair: Pair) -> None:
+        self._write(self.key_dir / PAIR_FILE, pair.to_dict(), 0o600)
+
+    def load_key_pair(self) -> Pair:
+        return Pair.from_dict(self._read(self.key_dir / PAIR_FILE))
+
+    # -- DKG share --------------------------------------------------------
+
+    def save_share(self, share: Share) -> None:
+        self._write(self.key_dir / SHARE_FILE, share.to_dict(), 0o600)
+
+    def load_share(self) -> Share:
+        return Share.from_dict(self._read(self.key_dir / SHARE_FILE))
+
+    # -- distributed public key ------------------------------------------
+
+    def save_dist_public(self, dist: DistPublic) -> None:
+        self._write(self.group_dir / DIST_FILE, dist.to_dict(), 0o644)
+
+    def load_dist_public(self) -> DistPublic:
+        return DistPublic.from_dict(self._read(self.group_dir / DIST_FILE))
+
+    # -- group ------------------------------------------------------------
+
+    def save_group(self, group: Group) -> None:
+        self._write(self.group_dir / GROUP_FILE, group.to_dict(), 0o644)
+
+    def load_group(self) -> Group:
+        return Group.from_dict(self._read(self.group_dir / GROUP_FILE))
+
+
+class MemStore:
+    """In-memory store with the same surface (for tests/daemon harness)."""
+
+    def __init__(self, pair: Optional[Pair] = None):
+        self._pair = pair
+        self._share: Optional[Share] = None
+        self._dist: Optional[DistPublic] = None
+        self._group: Optional[Group] = None
+
+    def save_key_pair(self, pair):
+        self._pair = pair
+
+    def load_key_pair(self):
+        if self._pair is None:
+            raise KeyNotFound("keypair")
+        return self._pair
+
+    def save_share(self, share):
+        self._share = share
+
+    def load_share(self):
+        if self._share is None:
+            raise KeyNotFound("share")
+        return self._share
+
+    def save_dist_public(self, dist):
+        self._dist = dist
+
+    def load_dist_public(self):
+        if self._dist is None:
+            raise KeyNotFound("dist public")
+        return self._dist
+
+    def save_group(self, group):
+        self._group = group
+
+    def load_group(self):
+        if self._group is None:
+            raise KeyNotFound("group")
+        return self._group

@@ -1,0 +1,13 @@
+"""Networking: gRPC services, TLS, REST gateway, control plane.
+
+Equivalent of the reference's `net/` package: `Gateway` (public gRPC+REST
+listener), `ControlListener` (localhost control port), connection-cached
+clients, and the certificate manager (/root/reference/net/)."""
+
+from drand_tpu.net.transport import (  # noqa: F401
+    ControlClient,
+    GrpcClient,
+    build_control_server,
+    build_public_server,
+)
+from drand_tpu.net.tls import CertManager, generate_self_signed  # noqa: F401

@@ -1,0 +1,94 @@
+"""REST gateway: JSON views of the public API over aiohttp.
+
+Mirrors the reference's grpc-gateway with hex-JSON marshalling
+(/root/reference/net/listener_grpc.go + net/json_marshaller.go):
+
+  GET  /api/public            latest beacon
+  GET  /api/public/{round}    beacon by round
+  POST /api/private           ECIES private randomness
+  GET  /api/info/group        group TOML
+  GET  /api/info/distkey      collective key coefficients
+  GET  /                      home/status
+
+Divergence from the reference: the reference cmux-shares one port between
+gRPC and REST; here REST listens on its own port (core.Config.rest_port).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+
+def build_rest_app(daemon) -> web.Application:
+    routes = web.RouteTableDef()
+
+    def beacon_json(b):
+        return {
+            "round": b.round,
+            "previous_round": b.prev_round,
+            "previous": b.prev_sig.hex(),
+            "signature": b.signature.hex(),
+            "randomness": b.randomness().hex(),
+        }
+
+    @routes.get("/")
+    async def home(request):
+        return web.json_response({"status": daemon.home_status()})
+
+    @routes.get("/api/public")
+    async def latest(request):
+        try:
+            b = daemon.fetch_public_rand(0)
+        except KeyError as exc:
+            raise web.HTTPNotFound(text=str(exc))
+        return web.json_response(beacon_json(b))
+
+    @routes.get("/api/public/{round}")
+    async def by_round(request):
+        try:
+            rnd = int(request.match_info["round"])
+        except ValueError:
+            raise web.HTTPBadRequest(text="round must be an integer")
+        try:
+            b = daemon.fetch_public_rand(rnd)
+        except KeyError as exc:
+            raise web.HTTPNotFound(text=str(exc))
+        return web.json_response(beacon_json(b))
+
+    @routes.post("/api/private")
+    async def private(request):
+        body = await request.json()
+        try:
+            blob = bytes.fromhex(body.get("request", ""))
+            out = daemon.serve_private_rand(blob)
+        except Exception as exc:
+            raise web.HTTPBadRequest(text=str(exc))
+        return web.json_response({"response": out.hex()})
+
+    @routes.get("/api/info/group")
+    async def group(request):
+        toml = daemon.group_toml()
+        if toml is None:
+            raise web.HTTPNotFound(text="no group configured")
+        return web.Response(text=toml, content_type="application/toml")
+
+    @routes.get("/api/info/distkey")
+    async def distkey(request):
+        try:
+            coeffs = daemon.collective_key_hex()
+        except Exception as exc:
+            raise web.HTTPNotFound(text=str(exc))
+        return web.json_response({"coefficients": coeffs})
+
+    app = web.Application()
+    app.add_routes(routes)
+    return app
+
+
+async def start_rest(app: web.Application, port: int,
+                     host: str = "0.0.0.0") -> web.AppRunner:
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    return runner

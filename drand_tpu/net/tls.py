@@ -1,0 +1,73 @@
+"""TLS material: self-signed certificate generation + trust pool.
+
+Mirrors /root/reference/net/certs.go (CertManager seeded with manually
+added PEMs for self-signed deployments) and the reference's use of
+kabukky/httpscerts to fabricate test certificates
+(core/drand_test.go:577-590).
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+
+def generate_self_signed(host: str) -> Tuple[bytes, bytes]:
+    """Return (cert_pem, key_pem) for a host ('127.0.0.1' or DNS name)."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, host)]
+    )
+    try:
+        san: x509.GeneralName = x509.IPAddress(
+            ipaddress.ip_address(host)
+        )
+    except ValueError:
+        san = x509.DNSName(host)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(
+            x509.SubjectAlternativeName([san]), critical=False
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    return cert_pem, key_pem
+
+
+class CertManager:
+    """Trust pool of PEM roots for dialing TLS peers."""
+
+    def __init__(self):
+        self._pems: List[bytes] = []
+
+    def add(self, cert_pem: bytes) -> None:
+        self._pems.append(cert_pem)
+
+    def add_file(self, path: str) -> None:
+        self.add(Path(path).read_bytes())
+
+    def pool(self) -> Optional[bytes]:
+        """Concatenated PEM bundle (None = system roots)."""
+        if not self._pems:
+            return None
+        return b"".join(self._pems)

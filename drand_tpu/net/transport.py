@@ -1,0 +1,590 @@
+"""gRPC transport: hand-wired servicers and connection-cached clients.
+
+Mirrors /root/reference/net/client_grpc.go (per-call deadlines, cached
+channels, streaming sync) and net/listener_grpc.go / net/control.go (the
+public gateway and the localhost-only control listener).  Method handlers
+are registered through grpc's generic-handler API because only protoc's
+message codegen is available in this environment — the service surface is
+defined by the `_METHODS` tables below.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import AsyncIterator, Dict, Optional
+
+import grpc
+import grpc.aio
+
+from drand_tpu.beacon.chain import Beacon
+from drand_tpu.beacon.handler import BeaconPacket, ProtocolClient
+from drand_tpu.key import Identity
+from drand_tpu.net import drand_tpu_pb2 as pb
+from drand_tpu.net.tls import CertManager
+
+log = logging.getLogger("drand_tpu.net")
+
+RPC_TIMEOUT = 1.0       # reference beacon/beacon.go:89 per-RPC deadline
+CONTROL_TIMEOUT = 10.0
+
+PUBLIC_SERVICE = "drandtpu.Public"
+PROTOCOL_SERVICE = "drandtpu.Protocol"
+CONTROL_SERVICE = "drandtpu.Control"
+
+
+def _beacon_to_record(b: Beacon) -> pb.BeaconRecord:
+    return pb.BeaconRecord(
+        round=b.round,
+        previous_round=b.prev_round,
+        previous_signature=b.prev_sig,
+        signature=b.signature,
+    )
+
+
+def _record_to_beacon(r: pb.BeaconRecord) -> Beacon:
+    return Beacon(
+        round=r.round,
+        prev_round=r.previous_round,
+        prev_sig=r.previous_signature,
+        signature=r.signature,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Servers.  `daemon` is a core.Drand (duck-typed; see core/daemon.py).
+# ---------------------------------------------------------------------------
+
+
+def build_public_server(daemon, address: str,
+                        tls: Optional[tuple] = None) -> grpc.aio.Server:
+    """The node-to-node + public gateway (Public and Protocol services)."""
+
+    async def public_rand(request, context):
+        try:
+            b = daemon.fetch_public_rand(request.round)
+        except KeyError as exc:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+        return pb.PublicRandResponse(
+            round=b.round,
+            previous_round=b.prev_round,
+            previous_signature=b.prev_sig,
+            signature=b.signature,
+            randomness=b.randomness(),
+        )
+
+    async def public_rand_stream(request, context):
+        queue = daemon.subscribe_beacons()
+        try:
+            while True:
+                b = await queue.get()
+                yield pb.PublicRandResponse(
+                    round=b.round,
+                    previous_round=b.prev_round,
+                    previous_signature=b.prev_sig,
+                    signature=b.signature,
+                    randomness=b.randomness(),
+                )
+        finally:
+            daemon.unsubscribe_beacons(queue)
+
+    async def private_rand(request, context):
+        try:
+            out = daemon.serve_private_rand(request.request)
+        except Exception as exc:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, str(exc)
+            )
+        return pb.PrivateRandResponse(response=out)
+
+    async def group(request, context):
+        toml = daemon.group_toml()
+        if toml is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "no group")
+        return pb.GroupResponse(group_toml=toml)
+
+    async def home(request, context):
+        return pb.HomeResponse(status=daemon.home_status())
+
+    async def new_beacon(request, context):
+        packet = BeaconPacket(
+            from_address=request.from_address,
+            round=request.round,
+            prev_round=request.previous_round,
+            prev_sig=request.previous_signature,
+            partial_sig=request.partial_signature,
+        )
+        try:
+            await daemon.process_beacon_packet(packet)
+        except Exception as exc:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, str(exc)
+            )
+        return pb.Empty()
+
+    async def sync_chain(request, context):
+        for b in daemon.serve_sync_chain(request.from_round):
+            yield _beacon_to_record(b)
+
+    async def setup(request, context):
+        await _dkg_inbound(daemon, request, context, reshare=False)
+        return pb.Empty()
+
+    async def reshare(request, context):
+        await _dkg_inbound(daemon, request, context, reshare=True)
+        return pb.Empty()
+
+    public_handlers = {
+        "PublicRand": grpc.unary_unary_rpc_method_handler(
+            public_rand,
+            request_deserializer=pb.PublicRandRequest.FromString,
+            response_serializer=pb.PublicRandResponse.SerializeToString,
+        ),
+        "PublicRandStream": grpc.unary_stream_rpc_method_handler(
+            public_rand_stream,
+            request_deserializer=pb.PublicRandRequest.FromString,
+            response_serializer=pb.PublicRandResponse.SerializeToString,
+        ),
+        "PrivateRand": grpc.unary_unary_rpc_method_handler(
+            private_rand,
+            request_deserializer=pb.PrivateRandRequest.FromString,
+            response_serializer=pb.PrivateRandResponse.SerializeToString,
+        ),
+        "Group": grpc.unary_unary_rpc_method_handler(
+            group,
+            request_deserializer=pb.GroupRequest.FromString,
+            response_serializer=pb.GroupResponse.SerializeToString,
+        ),
+        "Home": grpc.unary_unary_rpc_method_handler(
+            home,
+            request_deserializer=pb.HomeRequest.FromString,
+            response_serializer=pb.HomeResponse.SerializeToString,
+        ),
+    }
+    protocol_handlers = {
+        "NewBeacon": grpc.unary_unary_rpc_method_handler(
+            new_beacon,
+            request_deserializer=pb.BeaconPacketMsg.FromString,
+            response_serializer=pb.Empty.SerializeToString,
+        ),
+        "SyncChain": grpc.unary_stream_rpc_method_handler(
+            sync_chain,
+            request_deserializer=pb.SyncRequest.FromString,
+            response_serializer=pb.BeaconRecord.SerializeToString,
+        ),
+        "Setup": grpc.unary_unary_rpc_method_handler(
+            setup,
+            request_deserializer=pb.DKGPacketMsg.FromString,
+            response_serializer=pb.Empty.SerializeToString,
+        ),
+        "Reshare": grpc.unary_unary_rpc_method_handler(
+            reshare,
+            request_deserializer=pb.DKGPacketMsg.FromString,
+            response_serializer=pb.Empty.SerializeToString,
+        ),
+    }
+    server = grpc.aio.server()
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            PUBLIC_SERVICE, public_handlers
+        ),
+        grpc.method_handlers_generic_handler(
+            PROTOCOL_SERVICE, protocol_handlers
+        ),
+    ))
+    if tls is not None:
+        cert_pem, key_pem = tls
+        creds = grpc.ssl_server_credentials([(key_pem, cert_pem)])
+        server.add_secure_port(address, creds)
+    else:
+        server.add_insecure_port(address)
+    return server
+
+
+async def _dkg_inbound(daemon, request, context, reshare: bool):
+    try:
+        payload = json.loads(request.payload.decode())
+    except (UnicodeDecodeError, json.JSONDecodeError):
+        await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "bad payload")
+        return
+    try:
+        await daemon.process_dkg_packet(
+            payload, reshare=reshare, group_hash=request.group_hash
+        )
+    except Exception as exc:
+        await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(exc))
+
+
+def build_control_server(daemon, port: int) -> grpc.aio.Server:
+    """Localhost-only control service (reference net/control.go:21)."""
+
+    async def ping(request, context):
+        return pb.PingResponse()
+
+    async def init_dkg(request, context):
+        try:
+            dist = await daemon.init_dkg(
+                group_toml=request.group_toml,
+                is_leader=request.is_leader,
+                timeout=request.timeout_seconds or None,
+                entropy=request.entropy or None,
+            )
+        except Exception as exc:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                repr(exc))
+        return pb.InitResponse(dist_key_hex=dist)
+
+    async def init_reshare(request, context):
+        try:
+            dist = await daemon.init_reshare(
+                old_group_toml=request.old_group_toml or None,
+                new_group_toml=request.new_group_toml,
+                is_leader=request.is_leader,
+                timeout=request.timeout_seconds or None,
+            )
+        except Exception as exc:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                repr(exc))
+        return pb.InitResponse(dist_key_hex=dist)
+
+    async def share(request, context):
+        try:
+            idx, hexv = daemon.share_info()
+        except Exception as exc:
+            await context.abort(grpc.StatusCode.NOT_FOUND, repr(exc))
+        return pb.ShareResponse(index=idx, share_hex=hexv)
+
+    async def public_key(request, context):
+        return pb.KeyResponse(key_hex=daemon.public_key_hex())
+
+    async def private_key(request, context):
+        return pb.KeyResponse(key_hex=daemon.private_key_hex())
+
+    async def collective_key(request, context):
+        try:
+            coeffs = daemon.collective_key_hex()
+        except Exception as exc:
+            await context.abort(grpc.StatusCode.NOT_FOUND, repr(exc))
+        return pb.CollectiveKeyResponse(coefficients_hex=coeffs)
+
+    async def group_file(request, context):
+        toml = daemon.group_toml()
+        if toml is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "no group")
+        return pb.GroupResponse(group_toml=toml)
+
+    async def shutdown(request, context):
+        asyncio.get_running_loop().call_soon(daemon.request_shutdown)
+        return pb.ShutdownResponse()
+
+    handlers = {
+        "PingPong": grpc.unary_unary_rpc_method_handler(
+            ping,
+            request_deserializer=pb.PingRequest.FromString,
+            response_serializer=pb.PingResponse.SerializeToString,
+        ),
+        "InitDKG": grpc.unary_unary_rpc_method_handler(
+            init_dkg,
+            request_deserializer=pb.InitDKGRequest.FromString,
+            response_serializer=pb.InitResponse.SerializeToString,
+        ),
+        "InitReshare": grpc.unary_unary_rpc_method_handler(
+            init_reshare,
+            request_deserializer=pb.InitReshareRequest.FromString,
+            response_serializer=pb.InitResponse.SerializeToString,
+        ),
+        "Share": grpc.unary_unary_rpc_method_handler(
+            share,
+            request_deserializer=pb.ShareRequest.FromString,
+            response_serializer=pb.ShareResponse.SerializeToString,
+        ),
+        "PublicKey": grpc.unary_unary_rpc_method_handler(
+            public_key,
+            request_deserializer=pb.KeyRequest.FromString,
+            response_serializer=pb.KeyResponse.SerializeToString,
+        ),
+        "PrivateKey": grpc.unary_unary_rpc_method_handler(
+            private_key,
+            request_deserializer=pb.KeyRequest.FromString,
+            response_serializer=pb.KeyResponse.SerializeToString,
+        ),
+        "CollectiveKey": grpc.unary_unary_rpc_method_handler(
+            collective_key,
+            request_deserializer=pb.KeyRequest.FromString,
+            response_serializer=pb.CollectiveKeyResponse.SerializeToString,
+        ),
+        "GroupFile": grpc.unary_unary_rpc_method_handler(
+            group_file,
+            request_deserializer=pb.GroupFileRequest.FromString,
+            response_serializer=pb.GroupResponse.SerializeToString,
+        ),
+        "Shutdown": grpc.unary_unary_rpc_method_handler(
+            shutdown,
+            request_deserializer=pb.ShutdownRequest.FromString,
+            response_serializer=pb.ShutdownResponse.SerializeToString,
+        ),
+    }
+    server = grpc.aio.server()
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(CONTROL_SERVICE, handlers),
+    ))
+    server.add_insecure_port(f"127.0.0.1:{port}")
+    return server
+
+
+# ---------------------------------------------------------------------------
+# Clients.
+# ---------------------------------------------------------------------------
+
+
+class _ChannelCache:
+    def __init__(self, certs: Optional[CertManager] = None):
+        self.certs = certs or CertManager()
+        self._channels: Dict[tuple, grpc.aio.Channel] = {}
+
+    def get(self, address: str, tls: bool) -> grpc.aio.Channel:
+        key = (address, tls)
+        ch = self._channels.get(key)
+        if ch is None:
+            if tls:
+                creds = grpc.ssl_channel_credentials(
+                    root_certificates=self.certs.pool()
+                )
+                # self-signed deployment certs carry the peer IP/host in
+                # SAN; grpc validates against the dial target
+                ch = grpc.aio.secure_channel(address, creds)
+            else:
+                ch = grpc.aio.insecure_channel(address)
+            self._channels[key] = ch
+        return ch
+
+    async def close(self):
+        for ch in self._channels.values():
+            await ch.close()
+        self._channels.clear()
+
+
+class GrpcClient(ProtocolClient):
+    """Protocol-plane client: beacon broadcast, chain sync, DKG packets.
+
+    Implements beacon.ProtocolClient and (via `send_dkg`) dkg.DKGNetwork.
+    """
+
+    def __init__(self, certs: Optional[CertManager] = None):
+        self._cache = _ChannelCache(certs)
+        self.dkg_context: Optional[tuple] = None  # (reshare, group_hash)
+
+    async def close(self):
+        await self._cache.close()
+
+    def _method(self, peer: Identity, name: str, req_ser, resp_des,
+                stream=False):
+        ch = self._cache.get(peer.address, peer.tls)
+        factory = ch.unary_stream if stream else ch.unary_unary
+        return factory(
+            name, request_serializer=req_ser,
+            response_deserializer=resp_des,
+        )
+
+    async def new_beacon(self, peer: Identity,
+                         packet: BeaconPacket) -> None:
+        call = self._method(
+            peer, f"/{PROTOCOL_SERVICE}/NewBeacon",
+            pb.BeaconPacketMsg.SerializeToString, pb.Empty.FromString,
+        )
+        msg = pb.BeaconPacketMsg(
+            from_address=packet.from_address,
+            round=packet.round,
+            previous_round=packet.prev_round,
+            previous_signature=packet.prev_sig,
+            partial_signature=packet.partial_sig,
+        )
+        await call(msg, timeout=RPC_TIMEOUT)
+
+    async def sync_chain(self, peer: Identity,
+                         from_round: int) -> AsyncIterator[Beacon]:
+        call = self._method(
+            peer, f"/{PROTOCOL_SERVICE}/SyncChain",
+            pb.SyncRequest.SerializeToString, pb.BeaconRecord.FromString,
+            stream=True,
+        )
+        async for rec in call(pb.SyncRequest(from_round=from_round),
+                              timeout=30.0):
+            yield _record_to_beacon(rec)
+
+    async def send_dkg(self, peer: Identity, packet: dict) -> None:
+        """DKG packets must not be lost (full certification needs every
+        deal/response): retry a few times with backoff — the reference
+        relies on operator retry plus threshold certification; we retry
+        at the transport (cf. net/client_grpc.go:200-206 reconnect-once).
+        """
+        reshare, group_hash = self.dkg_context or (False, b"")
+        name = "Reshare" if reshare else "Setup"
+        call = self._method(
+            peer, f"/{PROTOCOL_SERVICE}/{name}",
+            pb.DKGPacketMsg.SerializeToString, pb.Empty.FromString,
+        )
+        msg = pb.DKGPacketMsg(
+            payload=json.dumps(packet).encode(), group_hash=group_hash
+        )
+        last_exc = None
+        for attempt in range(4):
+            try:
+                await call(msg, timeout=20.0)
+                return
+            except grpc.aio.AioRpcError as exc:
+                last_exc = exc
+                if exc.code() in (
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                ):
+                    # peer hasn't initialized its DKG yet (or rejected us):
+                    # wait and retry; give up on hard rejections last
+                    await asyncio.sleep(0.5 * (attempt + 1))
+                else:
+                    await asyncio.sleep(0.2 * (attempt + 1))
+        raise last_exc
+
+    # -- public API (used by the client library / CLI) --------------------
+
+    async def public_rand(self, peer: Identity, round: int = 0):
+        call = self._method(
+            peer, f"/{PUBLIC_SERVICE}/PublicRand",
+            pb.PublicRandRequest.SerializeToString,
+            pb.PublicRandResponse.FromString,
+        )
+        return await call(pb.PublicRandRequest(round=round),
+                          timeout=CONTROL_TIMEOUT)
+
+    async def public_rand_stream(self, peer: Identity):
+        call = self._method(
+            peer, f"/{PUBLIC_SERVICE}/PublicRandStream",
+            pb.PublicRandRequest.SerializeToString,
+            pb.PublicRandResponse.FromString,
+            stream=True,
+        )
+        async for resp in call(pb.PublicRandRequest()):
+            yield resp
+
+    async def private_rand(self, peer: Identity, blob: bytes) -> bytes:
+        call = self._method(
+            peer, f"/{PUBLIC_SERVICE}/PrivateRand",
+            pb.PrivateRandRequest.SerializeToString,
+            pb.PrivateRandResponse.FromString,
+        )
+        resp = await call(pb.PrivateRandRequest(request=blob),
+                          timeout=CONTROL_TIMEOUT)
+        return resp.response
+
+    async def group(self, peer: Identity) -> str:
+        call = self._method(
+            peer, f"/{PUBLIC_SERVICE}/Group",
+            pb.GroupRequest.SerializeToString, pb.GroupResponse.FromString,
+        )
+        resp = await call(pb.GroupRequest(), timeout=CONTROL_TIMEOUT)
+        return resp.group_toml
+
+    async def home(self, peer: Identity) -> str:
+        call = self._method(
+            peer, f"/{PUBLIC_SERVICE}/Home",
+            pb.HomeRequest.SerializeToString, pb.HomeResponse.FromString,
+        )
+        resp = await call(pb.HomeRequest(), timeout=CONTROL_TIMEOUT)
+        return resp.status
+
+
+class ControlClient:
+    """Client of the localhost control port (reference net/control.go:46)."""
+
+    def __init__(self, port: int):
+        self._channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+
+    async def close(self):
+        await self._channel.close()
+
+    def _call(self, name, req_ser, resp_des):
+        return self._channel.unary_unary(
+            f"/{CONTROL_SERVICE}/{name}",
+            request_serializer=req_ser, response_deserializer=resp_des,
+        )
+
+    async def ping(self) -> None:
+        await self._call(
+            "PingPong", pb.PingRequest.SerializeToString,
+            pb.PingResponse.FromString,
+        )(pb.PingRequest(), timeout=CONTROL_TIMEOUT)
+
+    async def init_dkg(self, group_toml: str, is_leader: bool,
+                       timeout: Optional[float] = None,
+                       entropy: Optional[bytes] = None,
+                       rpc_timeout: float = 600.0) -> str:
+        resp = await self._call(
+            "InitDKG", pb.InitDKGRequest.SerializeToString,
+            pb.InitResponse.FromString,
+        )(
+            pb.InitDKGRequest(
+                group_toml=group_toml, is_leader=is_leader,
+                timeout_seconds=timeout or 0.0, entropy=entropy or b"",
+            ),
+            timeout=rpc_timeout,
+        )
+        return resp.dist_key_hex
+
+    async def init_reshare(self, new_group_toml: str, is_leader: bool,
+                           old_group_toml: Optional[str] = None,
+                           timeout: Optional[float] = None,
+                           rpc_timeout: float = 600.0) -> str:
+        resp = await self._call(
+            "InitReshare", pb.InitReshareRequest.SerializeToString,
+            pb.InitResponse.FromString,
+        )(
+            pb.InitReshareRequest(
+                old_group_toml=old_group_toml or "",
+                new_group_toml=new_group_toml,
+                is_leader=is_leader, timeout_seconds=timeout or 0.0,
+            ),
+            timeout=rpc_timeout,
+        )
+        return resp.dist_key_hex
+
+    async def share(self):
+        resp = await self._call(
+            "Share", pb.ShareRequest.SerializeToString,
+            pb.ShareResponse.FromString,
+        )(pb.ShareRequest(), timeout=CONTROL_TIMEOUT)
+        return resp.index, resp.share_hex
+
+    async def public_key(self) -> str:
+        resp = await self._call(
+            "PublicKey", pb.KeyRequest.SerializeToString,
+            pb.KeyResponse.FromString,
+        )(pb.KeyRequest(), timeout=CONTROL_TIMEOUT)
+        return resp.key_hex
+
+    async def private_key(self) -> str:
+        resp = await self._call(
+            "PrivateKey", pb.KeyRequest.SerializeToString,
+            pb.KeyResponse.FromString,
+        )(pb.KeyRequest(), timeout=CONTROL_TIMEOUT)
+        return resp.key_hex
+
+    async def collective_key(self) -> list:
+        resp = await self._call(
+            "CollectiveKey", pb.KeyRequest.SerializeToString,
+            pb.CollectiveKeyResponse.FromString,
+        )(pb.KeyRequest(), timeout=CONTROL_TIMEOUT)
+        return list(resp.coefficients_hex)
+
+    async def group_file(self) -> str:
+        resp = await self._call(
+            "GroupFile", pb.GroupFileRequest.SerializeToString,
+            pb.GroupResponse.FromString,
+        )(pb.GroupFileRequest(), timeout=CONTROL_TIMEOUT)
+        return resp.group_toml
+
+    async def shutdown(self) -> None:
+        await self._call(
+            "Shutdown", pb.ShutdownRequest.SerializeToString,
+            pb.ShutdownResponse.FromString,
+        )(pb.ShutdownRequest(), timeout=CONTROL_TIMEOUT)

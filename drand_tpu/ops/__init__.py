@@ -15,4 +15,24 @@ computations suitable for the MXU/VPU:
 Everything is jit/vmap-compatible with static shapes: scalar loops are
 `lax.scan` / unrolled constant-trip loops, carries are fixed-pass parallel
 sweeps, there is no data-dependent control flow.
+
+Importing this package enables JAX's persistent compilation cache (set
+``DRAND_TPU_XLA_CACHE`` to relocate it, or to ``off`` to disable): the
+pairing pipeline costs minutes of XLA compile time per shape on a small
+host but milliseconds to reload from cache.
 """
+
+import os as _os
+
+import jax as _jax
+
+_cache = _os.environ.get("DRAND_TPU_XLA_CACHE", "")
+if _cache != "off":
+    if not _cache:
+        _cache = _os.path.join(
+            _os.path.expanduser("~"), ".cache", "drand_tpu_xla"
+        )
+    _os.makedirs(_cache, exist_ok=True)
+    _jax.config.update("jax_compilation_cache_dir", _cache)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

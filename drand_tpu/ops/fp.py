@@ -30,6 +30,8 @@ jit/vmap-compatible with static shapes.
 
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -186,7 +188,7 @@ def _conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     out = None
     for j in range(nb):
         term = a * b[..., j : j + 1]
-        pad = [(0, 0)] * (a.ndim - 1) + [(j, width - na - j)]
+        pad = [(0, 0)] * (term.ndim - 1) + [(j, width - na - j)]
         term = jnp.pad(term, pad)
         out = term if out is None else out + term
     return out
@@ -197,6 +199,7 @@ def _conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 # --------------------------------------------------------------------------
 
 
+@jax.jit
 def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """REDC(a*b): Montgomery product of two loosely-reduced elements.
 
@@ -225,15 +228,18 @@ def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return out
 
 
+@jax.jit
 def mont_sqr(a: jnp.ndarray) -> jnp.ndarray:
     return mont_mul(a, a)
 
 
+@jax.jit
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Field addition (lazy: limb add, carry sweep, one top fold)."""
     return _fold_top(_carry(a + b, NLIMB, passes=2), folds=1)
 
 
+@jax.jit
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Field subtraction: a - b + M where M = 0 mod p keeps limbs >= 0.
 
@@ -245,12 +251,14 @@ def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+@jax.jit
 def neg(a: jnp.ndarray) -> jnp.ndarray:
     return _fold_top(
         _carry(jnp.asarray(M_SUB) - a, NLIMB, passes=2), folds=3
     )
 
 
+@partial(jax.jit, static_argnums=1)
 def muls(a: jnp.ndarray, s: int) -> jnp.ndarray:
     """Multiply by a small static non-negative int (s <= 64)."""
     assert 0 <= s <= 64
@@ -265,11 +273,13 @@ def one_mont(shape=()) -> jnp.ndarray:
     return jnp.broadcast_to(jnp.asarray(ONE_MONT), (*shape, NLIMB))
 
 
+@jax.jit
 def to_mont(a: jnp.ndarray) -> jnp.ndarray:
     """Plain-integer limbs -> Montgomery form."""
     return mont_mul(a, jnp.asarray(RR_LIMBS))
 
 
+@jax.jit
 def from_mont(a: jnp.ndarray) -> jnp.ndarray:
     """Montgomery form -> plain value, loosely reduced (< p + 2^371)."""
     return mont_mul(a, jnp.asarray(ONE_PLAIN))
@@ -298,6 +308,7 @@ def _exact_carry_signed(x: jnp.ndarray):
     return jnp.moveaxis(ys, 0, -1), cf
 
 
+@jax.jit
 def canon(a: jnp.ndarray) -> jnp.ndarray:
     """Exact canonical plain-form limbs in [0, p) from a Montgomery input.
 
@@ -311,11 +322,13 @@ def canon(a: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(keep, vx, d)
 
 
+@jax.jit
 def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Exact field equality of two Montgomery-form elements -> bool (...)."""
     return jnp.all(canon(a) == canon(b), axis=-1)
 
 
+@jax.jit
 def is_zero(a: jnp.ndarray) -> jnp.ndarray:
     return jnp.all(canon(a) == 0, axis=-1)
 
@@ -325,6 +338,7 @@ def is_zero(a: jnp.ndarray) -> jnp.ndarray:
 # --------------------------------------------------------------------------
 
 
+@partial(jax.jit, static_argnums=1)
 def mont_pow(a: jnp.ndarray, e: int) -> jnp.ndarray:
     """a^e for a static python-int exponent, MSB-first square-and-multiply.
 
@@ -346,6 +360,7 @@ def mont_pow(a: jnp.ndarray, e: int) -> jnp.ndarray:
     return out
 
 
+@jax.jit
 def inv(a: jnp.ndarray) -> jnp.ndarray:
     """Montgomery-domain inverse via Fermat: a^(p-2). inv(0) = 0."""
     return mont_pow(a, P - 2)

@@ -1,0 +1,57 @@
+"""Multi-scalar multiplication (MSM) on G1/G2 — Lagrange recovery kernel.
+
+Replaces the inner loop of kyber's `share.RecoverCommit` (used by
+`tbls.Recover` at /root/reference/beacon/beacon.go:488): the reference
+computes sum_i lambda_i * S_i sequentially on the CPU; here the per-point
+scalar multiplications run as one batched 256-step double-and-select scan
+(vmapped over points), followed by a log-depth pairwise reduction tree —
+both fully on-device with static shapes.
+
+For drand committee sizes (t up to ~667) the vmap+tree shape is the right
+TPU mapping: all points advance through the same bit schedule in lockstep,
+so the work is one (B, ...) vector op per step with zero gathers; a
+Pippenger bucket method would need data-dependent scatters, which TPUs hate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from drand_tpu.ops.curve import (
+    F1,
+    F2,
+    FieldOps,
+    point_add,
+    point_identity,
+    scalar_mul,
+)
+
+
+def _msm(points, bits, F: FieldOps):
+    """sum_i bits_i * points_i.
+
+    points: (B, 3, *field_shape), bits: (B, 256) MSB-first.
+    Returns a single projective point (3, *field_shape).
+    """
+    b = points.shape[0]
+    prods = scalar_mul(points, bits, F)  # (B, 3, ...) batched scan
+    # pad to a power of two with the identity, then halve repeatedly
+    n = 1
+    while n < b:
+        n *= 2
+    if n != b:
+        pad = jnp.broadcast_to(
+            point_identity(F), (n - b, *prods.shape[1:])
+        )
+        prods = jnp.concatenate([prods, pad], axis=0)
+    while prods.shape[0] > 1:
+        half = prods.shape[0] // 2
+        prods = point_add(prods[:half], prods[half:], F)
+    return prods[0]
+
+
+g1_msm = jax.jit(partial(_msm, F=F1))
+g2_msm = jax.jit(partial(_msm, F=F2))

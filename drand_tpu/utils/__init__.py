@@ -1,0 +1,93 @@
+"""Small host-side utilities: minimal TOML writer, durations, hex codecs."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+
+def toml_dumps(data: Dict[str, Any]) -> str:
+    """Minimal TOML serializer for the subset the key store needs.
+
+    Supports: str/int/float/bool scalars, lists of strings, and lists of
+    dicts (rendered as [[table]] arrays).  Read back with stdlib tomllib.
+    """
+    lines: List[str] = []
+    tables: List[str] = []
+
+    def scalar(v) -> str:
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, (int, float)):
+            return repr(v)
+        if isinstance(v, str):
+            return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+        raise TypeError(f"unsupported TOML scalar: {type(v)}")
+
+    for k, v in data.items():
+        if isinstance(v, list) and v and isinstance(v[0], dict):
+            for item in v:
+                tables.append(f"[[{k}]]")
+                for ik, iv in item.items():
+                    tables.append(f"{ik} = {scalar(iv)}")
+                tables.append("")
+        elif isinstance(v, list):
+            inner = ", ".join(scalar(x) for x in v)
+            lines.append(f"{k} = [{inner}]")
+        elif isinstance(v, dict):
+            tables.append(f"[{k}]")
+            for ik, iv in v.items():
+                tables.append(f"{ik} = {scalar(iv)}")
+            tables.append("")
+        else:
+            lines.append(f"{k} = {scalar(v)}")
+    return "\n".join(lines + [""] + tables)
+
+
+def parse_duration(s) -> float:
+    """'30s' / '1m' / '1h30m' / numeric seconds -> seconds (float).
+
+    Mirrors the Go duration strings used in the reference's group files
+    (/root/reference/deploy/latest/group.toml:2 'Period = "1m0s"').
+    """
+    if isinstance(s, (int, float)):
+        return float(s)
+    s = s.strip()
+    units = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 0.001}
+    total = 0.0
+    num = ""
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c.isdigit() or c == ".":
+            num += c
+            i += 1
+        else:
+            u = c
+            if i + 1 < len(s) and not s[i + 1].isdigit() and s[i + 1] != ".":
+                u += s[i + 1]
+                i += 1
+            if u not in units or not num:
+                raise ValueError(f"bad duration: {s!r}")
+            total += float(num) * units[u]
+            num = ""
+            i += 1
+    if num:  # bare number = seconds
+        total += float(num)
+    return total
+
+
+def format_duration(seconds: float) -> str:
+    """Seconds -> compact Go-style duration string."""
+    if seconds != int(seconds):
+        return f"{seconds}s"
+    seconds = int(seconds)
+    h, rem = divmod(seconds, 3600)
+    m, s = divmod(rem, 60)
+    out = ""
+    if h:
+        out += f"{h}h"
+    if m:
+        out += f"{m}m"
+    if s or not out:
+        out += f"{s}s"
+    return out

@@ -1,0 +1,69 @@
+"""Injectable clocks: real time and a fake clock for deterministic tests.
+
+The reference threads `jonboulle/clockwork` fake clocks through every
+handler (beacon.Config.Clock /root/reference/beacon/beacon.go:34,
+core.Config.clock core/config.go:37) so multi-node protocol tests can
+drive rounds without wall time.  This is the asyncio equivalent: awaiting
+`clock.sleep(dt)` on a FakeClock parks the task until a test calls
+`advance(dt)`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import time
+from typing import List, Tuple
+
+
+class Clock:
+    """Real wall clock."""
+
+    def now(self) -> float:
+        return time.time()
+
+    async def sleep(self, seconds: float) -> None:
+        await asyncio.sleep(max(0.0, seconds))
+
+
+class FakeClock(Clock):
+    """Deterministic manual clock.
+
+    `advance(dt)` moves time forward and wakes every sleeper whose
+    deadline has passed, yielding control so woken tasks run promptly.
+    """
+
+    def __init__(self, start: float = 1_700_000_000.0):
+        self._now = start
+        self._sleepers: List[Tuple[float, int, asyncio.Future]] = []
+        self._seq = 0
+
+    def now(self) -> float:
+        return self._now
+
+    async def sleep(self, seconds: float) -> None:
+        if seconds <= 0:
+            await asyncio.sleep(0)
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._seq += 1
+        heapq.heappush(self._sleepers, (self._now + seconds, self._seq, fut))
+        await fut
+
+    async def advance(self, seconds: float) -> None:
+        """Move time forward, waking sleepers in deadline order."""
+        target = self._now + seconds
+        while self._sleepers and self._sleepers[0][0] <= target:
+            deadline, _, fut = heapq.heappop(self._sleepers)
+            self._now = max(self._now, deadline)
+            if not fut.done():
+                fut.set_result(None)
+            # let woken tasks (and anything they spawn) run
+            for _ in range(10):
+                await asyncio.sleep(0)
+        self._now = target
+        for _ in range(10):
+            await asyncio.sleep(0)
+
+    def pending_sleepers(self) -> int:
+        return len([s for s in self._sleepers if not s[2].done()])

@@ -6,11 +6,42 @@ multi-chip sharding paths (pjit/shard_map over a Mesh) are covered without
 hardware (mirrors the driver's dryrun_multichip harness).
 """
 
+import asyncio
+import inspect
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU regardless of the ambient JAX_PLATFORMS (the machine exposes a
+# real TPU via an experimental remote tunnel whose sitecustomize overrides
+# the env var at interpreter start — the config update below wins)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run `async def` tests with asyncio.run (no pytest-asyncio needed)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "asyncio: run test on a fresh asyncio event loop"
+    )
+    config.addinivalue_line(
+        "markers", "slow: multi-process E2E tests (several minutes)"
+    )

@@ -1,0 +1,167 @@
+"""Daemon-level integration: real gRPC, control-plane DKG, beacon rounds.
+
+Mirrors /root/reference/core/drand_test.go: n full daemons on localhost
+free ports, DKG driven through the real control client, fake-clock round
+production, verifying client + REST parity checks."""
+
+import asyncio
+import socket
+import time
+
+import aiohttp
+import pytest
+
+from drand_tpu.core import Config, Drand, DrandClient
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.key import Group, Pair
+from drand_tpu.net import ControlClient
+from drand_tpu.utils import toml_dumps
+from drand_tpu.utils.clock import FakeClock
+
+PERIOD = 30.0
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def wait_until(cond, timeout=60.0, interval=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+async def build_daemons(n, clock, rest_on_first=False):
+    ports = free_ports(2 * n + 1)
+    node_ports = ports[:n]
+    ctrl_ports = ports[n : 2 * n]
+    rest_port = ports[2 * n]
+    daemons = []
+    for i in range(n):
+        addr = f"127.0.0.1:{node_ports[i]}"
+        pair = Pair.generate(addr)
+        cfg = Config(
+            listen_addr=addr,
+            control_port=ctrl_ports[i],
+            clock=clock,
+            in_memory=True,
+            rest_port=rest_port if (rest_on_first and i == 0) else None,
+        )
+        daemons.append(await Drand.new(cfg, pair))
+    return daemons, ctrl_ports, rest_port
+
+
+@pytest.mark.asyncio
+async def test_full_dkg_beacon_client_rest():
+    clock = FakeClock()
+    n = 4
+    daemons, ctrl_ports, rest_port = await build_daemons(
+        n, clock, rest_on_first=True
+    )
+    group = Group(
+        nodes=[d.pair.public for d in daemons],
+        threshold=3,
+        period=PERIOD,
+        genesis_time=int(clock.now()) + 60,
+    )
+    group_toml = toml_dumps(group.to_dict())
+
+    ctrls = [ControlClient(p) for p in ctrl_ports]
+    for c in ctrls:
+        await c.ping()
+
+    # non-leaders first (handlers must exist when the leader's deals land)
+    tasks = [
+        asyncio.create_task(ctrls[i].init_dkg(group_toml, is_leader=False))
+        for i in range(1, n)
+    ]
+    await asyncio.sleep(0.3)
+    tasks.insert(0, asyncio.create_task(
+        ctrls[0].init_dkg(group_toml, is_leader=True)
+    ))
+    dist_hexes = await asyncio.wait_for(asyncio.gather(*tasks), 120)
+    assert len(set(dist_hexes)) == 1 and dist_hexes[0]
+    dist_key = ref.g1_from_bytes(bytes.fromhex(dist_hexes[0]))
+
+    # genesis + two rounds
+    await clock.advance(60)
+    assert await wait_until(
+        lambda: all(
+            d.beacon and d.beacon.store.last()
+            and d.beacon.store.last().round >= 1
+            for d in daemons
+        )
+    ), "round 1 did not complete"
+    await clock.advance(PERIOD)
+    assert await wait_until(
+        lambda: all(
+            d.beacon.store.last().round >= 2 for d in daemons
+        )
+    ), "round 2 did not complete"
+
+    # verifying client over real gRPC
+    client = DrandClient(dist_key)
+    peer = daemons[0].pair.public
+    last = await client.last_public(peer)
+    assert last.round >= 2
+    b1 = await client.public(peer, 1)
+    assert b1.round == 1
+    priv = await client.private(peer)
+    assert len(priv) == 32
+
+    # control-plane introspection
+    idx, share_hex = await ctrls[1].share()
+    assert idx == 1 and len(share_hex) == 64
+    coeffs = await ctrls[0].collective_key()
+    assert coeffs[0] == dist_hexes[0]
+    gtoml = await ctrls[0].group_file()
+    assert "Nodes" in gtoml
+    pub_hex = await ctrls[2].public_key()
+    assert pub_hex == daemons[2].pair.public.key_hex
+
+    # REST parity with gRPC
+    async with aiohttp.ClientSession() as http:
+        async with http.get(
+            f"http://127.0.0.1:{rest_port}/api/public/1"
+        ) as resp:
+            assert resp.status == 200
+            j = await resp.json()
+        assert j["round"] == 1
+        assert bytes.fromhex(j["signature"]) == b1.signature
+        assert bytes.fromhex(j["randomness"]) == b1.randomness()
+        async with http.get(
+            f"http://127.0.0.1:{rest_port}/api/info/distkey"
+        ) as resp:
+            dj = await resp.json()
+        assert dj["coefficients"][0] == dist_hexes[0]
+        async with http.get(
+            f"http://127.0.0.1:{rest_port}/api/public/999"
+        ) as resp:
+            assert resp.status == 404
+
+    await client.close()
+    for c in ctrls:
+        await c.close()
+    for d in daemons:
+        await d.stop()
+
+
+@pytest.mark.asyncio
+async def test_wrong_group_hash_dkg_packet_rejected():
+    clock = FakeClock()
+    daemons, ctrl_ports, _ = await build_daemons(1, clock)
+    d = daemons[0]
+    with pytest.raises(ValueError):
+        await d.process_dkg_packet({}, reshare=False, group_hash=b"x")
+    await d.stop()

@@ -1,0 +1,76 @@
+"""G1/G2 complete projective arithmetic vs the pure-Python oracle."""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.ops import curve
+
+rng = random.Random(0xC0DE)
+
+
+def test_g1_add_double_vs_oracle():
+    k1, k2 = rng.randrange(ref.R), rng.randrange(ref.R)
+    p1 = ref.g1_mul(ref.G1_GEN, k1)
+    p2 = ref.g1_mul(ref.G1_GEN, k2)
+    a, b = curve.g1_encode(p1), curve.g1_encode(p2)
+    assert curve.g1_decode(curve.g1_add(a, b)) == ref.g1_add(p1, p2)
+    assert curve.g1_decode(curve.g1_double(a)) == ref.g1_add(p1, p1)
+    # complete formulas: add(p, p) must equal double(p)
+    assert curve.g1_decode(curve.g1_add(a, a)) == ref.g1_add(p1, p1)
+
+
+def test_g1_identity_and_inverse_edges():
+    p1 = ref.g1_mul(ref.G1_GEN, 12345)
+    a = curve.g1_encode(p1)
+    inf = curve.g1_identity()
+    assert curve.g1_decode(curve.g1_add(a, inf)) == p1
+    assert curve.g1_decode(curve.g1_add(inf, a)) == p1
+    assert curve.g1_decode(curve.g1_add(inf, inf)) is None
+    assert curve.g1_decode(curve.g1_add(a, curve.g1_neg(a))) is None
+    assert curve.g1_decode(curve.g1_double(inf)) is None
+
+
+def test_g1_scalar_mul_vs_oracle():
+    ks = [0, 1, 2, rng.randrange(ref.R), ref.R - 1]
+    base = curve.g1_encode(ref.G1_GEN)
+    for k in ks:
+        bits = jnp.asarray(curve.scalar_to_bits(k))
+        got = curve.g1_decode(curve.g1_scalar_mul(base, bits))
+        assert got == ref.g1_mul(ref.G1_GEN, k), f"k={k}"
+
+
+def test_g1_scalar_mul_batched():
+    ks = [rng.randrange(ref.R) for _ in range(4)]
+    pts = [ref.g1_mul(ref.G1_GEN, rng.randrange(ref.R)) for _ in range(4)]
+    basis = jnp.stack([curve.g1_encode(p) for p in pts])
+    bits = jnp.asarray(np.stack([curve.scalar_to_bits(k) for k in ks]))
+    out = curve.g1_scalar_mul(basis, bits)
+    for i in range(4):
+        assert curve.g1_decode(out[i]) == ref.g1_mul(pts[i], ks[i])
+
+
+def test_g2_ops_vs_oracle():
+    k1, k2 = rng.randrange(ref.R), rng.randrange(ref.R)
+    p1 = ref.g2_mul(ref.G2_GEN, k1)
+    p2 = ref.g2_mul(ref.G2_GEN, k2)
+    a, b = curve.g2_encode(p1), curve.g2_encode(p2)
+    assert curve.g2_decode(curve.g2_add(a, b)) == ref.g2_add(p1, p2)
+    assert curve.g2_decode(curve.g2_add(a, a)) == ref.g2_add(p1, p1)
+    assert curve.g2_decode(curve.g2_add(a, curve.g2_neg(a))) is None
+    k = rng.randrange(1 << 64)
+    bits = jnp.asarray(curve.scalar_to_bits(k))
+    assert curve.g2_decode(curve.g2_scalar_mul(a, bits)) == ref.g2_mul(p1, k)
+
+
+def test_point_eq():
+    p1 = ref.g1_mul(ref.G1_GEN, 777)
+    a = curve.g1_encode(p1)
+    doubled = curve.g1_add(a, a)
+    b = curve.g1_encode(ref.g1_add(p1, p1))
+    assert bool(curve.g1_eq(doubled, b))          # differing Z, same point
+    assert not bool(curve.g1_eq(a, b))
+    assert bool(curve.g1_eq(curve.g1_identity(), curve.g1_identity()))
+    assert not bool(curve.g1_eq(a, curve.g1_identity()))

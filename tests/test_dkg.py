@@ -1,0 +1,228 @@
+"""DKG: pure state machine + network protocol (reference dkg/dkg_test.go).
+
+Covers fresh DKG, threshold certification under timeout with an offline
+node, resharing to a larger group with the collective key preserved, and
+deal tampering."""
+
+import asyncio
+import random
+
+import pytest
+
+from drand_tpu.crypto import ecies
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.poly import PriPoly, recover_secret
+from drand_tpu.dkg import (
+    Deal,
+    DKGConfig,
+    DKGError,
+    DKGHandler,
+    DistKeyGenerator,
+)
+from drand_tpu.key import Group, Pair, Share
+from drand_tpu.utils.clock import FakeClock
+
+
+def make_pairs(n, seed, base_port=7000):
+    r = random.Random(seed)
+    return [
+        Pair.generate(f"127.0.0.1:{base_port + i}", rng=r.randbytes)
+        for i in range(n)
+    ]
+
+
+def run_engine_dkg(pairs, t):
+    """Drive DistKeyGenerators directly (no networking)."""
+    nodes = [p.public for p in pairs]
+    gens = [
+        DistKeyGenerator(pair=p, participants=nodes, threshold=t)
+        for p in pairs
+    ]
+    responses = []
+    for g in gens:
+        for deal in g.deals():
+            resp = gens[deal.recipient_index].process_deal(deal)
+            responses.append(resp)
+    for g in gens:
+        for resp in responses:
+            if resp.verifier_index != g.index:
+                g.process_response(resp)
+    return gens
+
+
+def test_engine_fresh_dkg_produces_consistent_key():
+    pairs = make_pairs(5, 21)
+    t = 3
+    gens = run_engine_dkg(pairs, t)
+    assert all(g.certified() for g in gens)
+    shares = [g.dist_key_share() for g in gens]
+    # identical commitments everywhere
+    c0 = shares[0].commits
+    assert all(s.commits == c0 for s in shares)
+    # shares interpolate to the secret committed in coefficient 0
+    secret = recover_secret([s.share for s in shares[:t]], t)
+    assert ref.g1_mul(ref.G1_GEN, secret) == c0[0]
+    # and any other subset agrees
+    secret2 = recover_secret([s.share for s in shares[2:]], t)
+    assert secret2 == secret
+    # the shares actually sign: 3-of-5 threshold BLS round-trip
+    scheme = tbls.RefScheme()
+    pub = shares[0].pub_poly()
+    partials = [
+        scheme.partial_sign(s.share, b"dkg-msg") for s in shares[:t]
+    ]
+    sig = scheme.recover(pub, b"dkg-msg", partials, t, 5)
+    scheme.verify_recovered(c0[0], b"dkg-msg", sig)
+
+
+def test_engine_rejects_tampered_deal():
+    pairs = make_pairs(4, 22)
+    nodes = [p.public for p in pairs]
+    g0 = DistKeyGenerator(pair=pairs[0], participants=nodes, threshold=3)
+    g1 = DistKeyGenerator(pair=pairs[1], participants=nodes, threshold=3)
+    deal = [d for d in g0.deals() if d.recipient_index == 1][0]
+    bad = Deal(
+        dealer_index=deal.dealer_index,
+        recipient_index=deal.recipient_index,
+        commits_bytes=deal.commits_bytes,
+        encrypted_share=deal.encrypted_share[:-1]
+        + bytes([deal.encrypted_share[-1] ^ 1]),
+    )
+    resp = g1.process_deal(bad)
+    assert not resp.approved
+    # wrong recipient rejected outright
+    deal2 = [d for d in g0.deals() if d.recipient_index == 2][0]
+    with pytest.raises(DKGError):
+        g1.process_deal(deal2)
+
+
+class DKGNet:
+    """Loopback DKG transport."""
+
+    def __init__(self):
+        self.handlers = {}
+        self.down = set()
+
+    def register(self, address, handler):
+        self.handlers[address] = handler
+
+    async def send_dkg(self, peer, packet):
+        if peer.address in self.down or peer.address not in self.handlers:
+            raise ConnectionError(f"{peer.address} down")
+        await self.handlers[peer.address].process(packet)
+
+
+async def drive_dkg(handlers, leader=0):
+    await handlers[leader].start()
+    for _ in range(50):
+        await asyncio.sleep(0)
+    return [h.wait_share() for h in handlers]
+
+
+@pytest.mark.asyncio
+async def test_handler_fresh_dkg_full_certification():
+    pairs = make_pairs(4, 23)
+    clock = FakeClock()
+    group = Group(nodes=[p.public for p in pairs], threshold=3,
+                  genesis_time=int(clock.now()) + 100)
+    net = DKGNet()
+    handlers = []
+    for p in pairs:
+        h = DKGHandler(
+            DKGConfig(pair=p, new_group=group, clock=clock), net
+        )
+        net.register(p.public.address, h)
+        handlers.append(h)
+    futs = await drive_dkg(handlers)
+    shares = [await asyncio.wait_for(f, 5) for f in futs]
+    assert all(s is not None for s in shares)
+    c0 = shares[0].commits
+    assert all(s.commits == c0 for s in shares)
+    secret = recover_secret([s.share for s in shares[:3]], 3)
+    assert ref.g1_mul(ref.G1_GEN, secret) == c0[0]
+
+
+@pytest.mark.asyncio
+async def test_handler_dkg_timeout_with_offline_node():
+    pairs = make_pairs(4, 24)
+    clock = FakeClock()
+    group = Group(nodes=[p.public for p in pairs], threshold=3,
+                  genesis_time=int(clock.now()) + 1000)
+    net = DKGNet()
+    net.down.add(pairs[3].public.address)  # one dealer never shows up
+    handlers = []
+    for p in pairs[:3]:
+        h = DKGHandler(
+            DKGConfig(pair=p, new_group=group, clock=clock, timeout=30),
+            net,
+        )
+        net.register(p.public.address, h)
+        handlers.append(h)
+    futs = await drive_dkg(handlers)
+    # not fully certified: needs the timeout to accept 3-of-4 dealers
+    assert not any(f.done() for f in futs)
+    await clock.advance(31)
+    shares = [await asyncio.wait_for(f, 5) for f in futs]
+    assert all(s is not None for s in shares)
+    secret = recover_secret([s.share for s in shares], 3)
+    assert ref.g1_mul(ref.G1_GEN, secret) == shares[0].commits[0]
+
+
+@pytest.mark.asyncio
+async def test_handler_reshare_preserves_collective_key():
+    # fresh 3-of-4, then reshare to 4-of-6 (two new members)
+    old_pairs = make_pairs(4, 25)
+    clock = FakeClock()
+    old_group = Group(nodes=[p.public for p in old_pairs], threshold=3,
+                      genesis_time=int(clock.now()) + 1000)
+    net = DKGNet()
+    handlers = []
+    for p in old_pairs:
+        h = DKGHandler(
+            DKGConfig(pair=p, new_group=old_group, clock=clock), net
+        )
+        net.register(p.public.address, h)
+        handlers.append(h)
+    futs = await drive_dkg(handlers)
+    old_shares = [await asyncio.wait_for(f, 5) for f in futs]
+    dist_key = old_shares[0].commits[0]
+
+    new_pairs = old_pairs[:4] + make_pairs(2, 26, base_port=7700)
+    new_group = Group(nodes=[p.public for p in new_pairs], threshold=4,
+                      genesis_time=int(clock.now()) + 1000)
+    net2 = DKGNet()
+    handlers2 = []
+    for i, p in enumerate(new_pairs):
+        old_share = old_shares[i] if i < 4 else None
+        h = DKGHandler(
+            DKGConfig(
+                pair=p, new_group=new_group, old_group=old_group,
+                old_share=old_share, clock=clock,
+            ),
+            net2,
+        )
+        net2.register(p.public.address, h)
+        handlers2.append(h)
+    futs2 = await drive_dkg(handlers2)
+    new_shares = [await asyncio.wait_for(f, 5) for f in futs2]
+    assert all(s is not None for s in new_shares)
+    # same collective key, new sharing
+    assert new_shares[0].commits[0] == dist_key
+    secret = recover_secret([s.share for s in new_shares[:4]], 4)
+    assert ref.g1_mul(ref.G1_GEN, secret) == dist_key
+    # old shares and new shares differ (fresh randomness)
+    assert new_shares[0].share.value != old_shares[0].share.value
+
+
+def test_ecies_roundtrip_and_tamper():
+    pair = make_pairs(1, 27)[0]
+    blob = ecies.encrypt(pair.public.key, b"secret share", b"ctx")
+    assert ecies.decrypt(pair.private, blob, b"ctx") == b"secret share"
+    with pytest.raises(ecies.EciesError):
+        ecies.decrypt(pair.private, blob, b"other-ctx")
+    with pytest.raises(ecies.EciesError):
+        ecies.decrypt(pair.private + 1, blob, b"ctx")
+    bad = blob[:-1] + bytes([blob[-1] ^ 1])
+    with pytest.raises(ecies.EciesError):
+        ecies.decrypt(pair.private, bad, b"ctx")

@@ -1,0 +1,119 @@
+"""Key/group model: TOML roundtrips, hashes, thresholds (reference tier 1)."""
+
+import random
+
+import pytest
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto.poly import PriPoly
+from drand_tpu.key import (
+    DistPublic,
+    FileStore,
+    Group,
+    Identity,
+    Pair,
+    Share,
+    default_threshold,
+    minimum_threshold,
+)
+from drand_tpu.key.group import merge_groups
+from drand_tpu.utils import format_duration, parse_duration
+
+
+def make_pairs(n, seed=7):
+    r = random.Random(seed)
+    return [
+        Pair.generate(f"127.0.0.1:{8000 + i}", rng=r.randbytes)
+        for i in range(n)
+    ]
+
+
+def test_pair_roundtrip_and_keygen():
+    pair = make_pairs(1)[0]
+    assert pair.public.key == ref.g1_mul(ref.G1_GEN, pair.private)
+    again = Pair.from_dict(pair.to_dict())
+    assert again.private == pair.private
+    assert again.public == pair.public
+
+
+def test_group_roundtrip_hash_and_index():
+    pairs = make_pairs(5)
+    ids = [p.public for p in pairs]
+    g = Group(nodes=ids, threshold=3, period=30.0, genesis_time=1700000000)
+    assert g.index(ids[2]) == 2
+    assert g.index(Pair.generate("x:1").public) is None
+    h1 = g.hash()
+    g2 = Group.from_dict(g.to_dict())
+    assert g2.hash() == h1
+    assert g2.period == 30.0
+    # seed defaults to hash and then persists through TOML
+    seed = g.get_genesis_seed()
+    assert seed == h1
+    g3 = Group.from_dict(g.to_dict())
+    assert g3.get_genesis_seed() == seed
+    # node change changes the hash
+    g4 = Group(nodes=ids[:4], threshold=3, genesis_time=1700000000)
+    assert g4.hash() != h1
+
+
+def test_group_threshold_bounds():
+    ids = [p.public for p in make_pairs(4)]
+    with pytest.raises(ValueError):
+        Group(nodes=ids, threshold=1)
+    with pytest.raises(ValueError):
+        Group(nodes=ids, threshold=5)
+    assert default_threshold(5) == 3
+    assert minimum_threshold(4) == 2
+
+
+def test_merge_groups_dedup():
+    a, b, c, d = [p.public for p in make_pairs(4)]
+    merged = merge_groups([a, b, c], [c, d])
+    assert merged == [c, d, a, b]
+
+
+def test_share_and_dist_public_roundtrip():
+    poly = PriPoly.random(3, rng=random.Random(9).randbytes)
+    pub = poly.commit()
+    share = Share(commits=pub.commits, share=poly.eval(1))
+    s2 = Share.from_dict(share.to_dict())
+    assert s2.share == share.share
+    assert s2.commits == share.commits
+    dist = share.public()
+    d2 = DistPublic.from_dict(dist.to_dict())
+    assert d2.equal(dist)
+    assert d2.key() == ref.g1_mul(ref.G1_GEN, poly.secret())
+
+
+def test_file_store_roundtrip(tmp_path):
+    store = FileStore(str(tmp_path / "node0"))
+    pair = make_pairs(1)[0]
+    store.save_key_pair(pair)
+    assert store.load_key_pair().private == pair.private
+
+    ids = [p.public for p in make_pairs(4, seed=11)]
+    g = Group(nodes=ids, threshold=2, genesis_time=1700000001)
+    g.get_genesis_seed()
+    store.save_group(g)
+    assert store.load_group().hash() == g.hash()
+
+    poly = PriPoly.random(2, rng=random.Random(12).randbytes)
+    share = Share(commits=poly.commit().commits, share=poly.eval(0))
+    store.save_share(share)
+    assert store.load_share().share.value == share.share.value
+    store.save_dist_public(share.public())
+    assert store.load_dist_public().equal(share.public())
+
+    # private files must not be world-readable
+    import os
+    mode = os.stat(store.key_dir / "drand_id.toml").st_mode & 0o777
+    assert mode == 0o600
+
+
+def test_duration_helpers():
+    assert parse_duration("1m") == 60.0
+    assert parse_duration("1m30s") == 90.0
+    assert parse_duration("2h") == 7200.0
+    assert parse_duration(45) == 45.0
+    assert parse_duration(format_duration(90.0)) == 90.0
+    assert parse_duration(format_duration(0.5)) == 0.5

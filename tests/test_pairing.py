@@ -1,0 +1,92 @@
+"""JAX pairing vs the pure-Python oracle.
+
+The device computes the CUBED pairing e(P,Q)^3 (see pairing.py); since
+gcd(3, r) = 1 this is compared against the oracle's pairing cubed.
+"""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.ops import fp, tower, pairing
+
+rng = random.Random(0xABCD)
+
+
+def enc_g1(pt):
+    return jnp.stack([fp.fp_encode(pt[0]), fp.fp_encode(pt[1])])
+
+
+def enc_g2(pt):
+    return jnp.stack([tower.fp2_encode(pt[0]), tower.fp2_encode(pt[1])])
+
+
+def test_single_pairing_vs_oracle():
+    a = rng.randrange(1, 2**32)
+    b = rng.randrange(1, 2**32)
+    p = ref.g1_mul(ref.G1_GEN, a)
+    q = ref.g2_mul(ref.G2_GEN, b)
+    got = tower.fp12_decode(pairing.pairing(enc_g1(p), enc_g2(q)))
+    want = ref.fp12_pow(ref.pairing(p, q), 3)
+    assert got == want
+
+
+def test_pairing_batched_and_bilinear():
+    scal = [(rng.randrange(1, 2**16), rng.randrange(1, 2**16))
+            for _ in range(3)]
+    ps = jnp.stack([enc_g1(ref.g1_mul(ref.G1_GEN, a)) for a, _ in scal])
+    qs = jnp.stack([enc_g2(ref.g2_mul(ref.G2_GEN, b)) for _, b in scal])
+    out = pairing.pairing(ps, qs)
+    e_gh_3 = ref.fp12_pow(ref.pairing(ref.G1_GEN, ref.G2_GEN), 3)
+    for i, (a, b) in enumerate(scal):
+        assert tower.fp12_decode(out[i]) == ref.fp12_pow(
+            e_gh_3, a * b % ref.R
+        )
+
+
+def test_product_check_signature_shape():
+    # e(-G, sig) * e(pk, H) == 1  with sig = H^sk, pk = G^sk
+    sk = rng.randrange(1, ref.R)
+    h = ref.hash_to_g2(b"round-42-msg")
+    sig = ref.g2_mul(h, sk)
+    pk = ref.g1_mul(ref.G1_GEN, sk)
+    neg_g = ref.g1_neg(ref.G1_GEN)
+
+    ok = pairing.pairing_product_check(
+        enc_g1(neg_g), enc_g2(sig), enc_g1(pk), enc_g2(h)
+    )
+    assert bool(ok)
+
+    # tampered signature must fail
+    bad = ref.g2_mul(h, sk + 1)
+    ok2 = pairing.pairing_product_check(
+        enc_g1(neg_g), enc_g2(bad), enc_g1(pk), enc_g2(h)
+    )
+    assert not bool(ok2)
+
+    # wrong message must fail
+    h2 = ref.hash_to_g2(b"round-43-msg")
+    ok3 = pairing.pairing_product_check(
+        enc_g1(neg_g), enc_g2(sig), enc_g1(pk), enc_g2(h2)
+    )
+    assert not bool(ok3)
+
+
+def test_product_check_batched():
+    sks = [rng.randrange(1, ref.R) for _ in range(4)]
+    msgs = [b"m0", b"m1", b"m2", b"m3"]
+    hs = [ref.hash_to_g2(m) for m in msgs]
+    sigs = [ref.g2_mul(h, sk) for h, sk in zip(hs, sks)]
+    pks = [ref.g1_mul(ref.G1_GEN, sk) for sk in sks]
+    # corrupt entry 2
+    sigs[2] = ref.g2_mul(sigs[2], 7)
+    neg_g = ref.g1_neg(ref.G1_GEN)
+
+    p1 = jnp.stack([enc_g1(neg_g)] * 4)
+    q1 = jnp.stack([enc_g2(s) for s in sigs])
+    p2 = jnp.stack([enc_g1(pk) for pk in pks])
+    q2 = jnp.stack([enc_g2(h) for h in hs])
+    ok = np.asarray(pairing.pairing_product_check(p1, q1, p2, q2))
+    assert ok.tolist() == [True, True, False, True]

@@ -1,0 +1,127 @@
+"""Threshold-BLS scheme: ref and jax backends, 3-of-5 and recovery edges."""
+
+import random
+
+import pytest
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.poly import (
+    PriPoly,
+    PriShare,
+    lagrange_basis_at_zero,
+    recover_secret,
+)
+
+rng = random.Random(0x7B15)
+MSG = b"drand-tpu round 1 message"
+
+
+def fixed_group(t, seed):
+    r = random.Random(seed)
+    return PriPoly.random(t, rng=r.randbytes)
+
+
+def test_poly_secret_sharing_roundtrip():
+    poly = fixed_group(3, 42)
+    shares = poly.shares(5)
+    assert recover_secret(shares[:3], 3) == poly.secret()
+    assert recover_secret(shares[2:], 3) == poly.secret()
+    with pytest.raises(ValueError):
+        recover_secret(shares[:2], 3)
+    lam = lagrange_basis_at_zero([0, 1, 2])
+    assert sum(lam[s.index] * s.value for s in shares[:3]) % ref.R == \
+        poly.secret()
+
+
+def test_pubpoly_eval_matches_exponent():
+    poly = fixed_group(3, 43)
+    pub = poly.commit()
+    for i in (0, 2, 4):
+        sh = poly.eval(i)
+        assert pub.eval(i) == ref.g1_mul(ref.G1_GEN, sh.value)
+    assert pub.commit() == ref.g1_mul(ref.G1_GEN, poly.secret())
+
+
+def _run_scheme_3_of_5(scheme):
+    t, n = 3, 5
+    poly = fixed_group(t, 44)
+    pub = poly.commit()
+    shares = poly.shares(n)
+    partials = [scheme.partial_sign(s, MSG) for s in shares]
+    for pb in partials:
+        scheme.verify_partial(pub, MSG, pb)
+    assert scheme.index_of(partials[2]) == 2
+
+    sig = scheme.recover(pub, MSG, partials[:t], t, n)
+    # recovery must be independent of which t partials were used
+    sig2 = scheme.recover(pub, MSG, partials[2:], t, n)
+    assert sig == sig2
+    scheme.verify_recovered(pub.commit(), MSG, sig)
+
+    # the full signature equals signing with the master secret
+    h = ref.hash_to_g2(MSG)
+    assert sig == ref.g2_to_bytes(ref.g2_mul(h, poly.secret()))
+
+    # tampered partial rejected
+    bad = bytearray(partials[0])
+    bad[0:2] = (1).to_bytes(2, "big")  # claim wrong index
+    with pytest.raises(tbls.ThresholdError):
+        scheme.verify_partial(pub, MSG, bytes(bad))
+    with pytest.raises(tbls.ThresholdError):
+        scheme.recover(pub, MSG, partials[:t - 1], t, n)
+    # duplicate partials don't count twice
+    with pytest.raises(tbls.ThresholdError):
+        scheme.recover(pub, MSG, [partials[0]] * t, t, n)
+
+
+def test_ref_scheme_3_of_5():
+    _run_scheme_3_of_5(tbls.RefScheme())
+
+
+def test_jax_scheme_3_of_5():
+    _run_scheme_3_of_5(tbls.JaxScheme())
+
+
+def test_backends_interoperate():
+    t, n = 2, 3
+    poly = fixed_group(t, 45)
+    pub = poly.commit()
+    shares = poly.shares(n)
+    a, b = tbls.RefScheme(), tbls.JaxScheme()
+    partials = [a.partial_sign(shares[0], MSG), b.partial_sign(shares[1], MSG)]
+    for pb in partials:
+        a.verify_partial(pub, MSG, pb)
+        b.verify_partial(pub, MSG, pb)
+    sig_a = a.recover(pub, MSG, partials, t, n)
+    sig_b = b.recover(pub, MSG, partials, t, n)
+    assert sig_a == sig_b
+    b.verify_recovered(pub.commit(), MSG, sig_a)
+
+
+def test_jax_batch_partial_verify():
+    t, n = 3, 6
+    poly = fixed_group(t, 46)
+    pub = poly.commit()
+    shares = poly.shares(n)
+    scheme = tbls.JaxScheme()
+    partials = [tbls.RefScheme().partial_sign(s, MSG) for s in shares]
+    # corrupt two of them in different ways
+    p_badidx = bytearray(partials[1]); p_badidx[0:2] = (4).to_bytes(2, "big")
+    partials[1] = bytes(p_badidx)
+    partials[3] = partials[3][:-1] + bytes([partials[3][-1] ^ 1])
+    got = scheme.verify_partials_batch(pub, MSG, partials)
+    assert got == [True, False, True, False, True, True]
+
+
+def test_jax_chain_batch_verify():
+    poly = fixed_group(2, 47)
+    sk = poly.secret()
+    pk = ref.g1_mul(ref.G1_GEN, sk)
+    msgs = [f"round-{i}".encode() for i in range(5)]
+    sigs = [ref.g2_to_bytes(ref.g2_mul(ref.hash_to_g2(m), sk)) for m in msgs]
+    sigs[2] = sigs[3]  # signature for the wrong message
+    scheme = tbls.JaxScheme()
+    got = scheme.verify_chain_batch(pk, msgs, sigs)
+    assert got == [True, True, False, True, True]
+    assert len(tbls.randomness(sigs[0])) == 32
