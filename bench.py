@@ -51,7 +51,7 @@ def main() -> None:
         np.stack([curve.scalar_to_bits(s) for s in scalars])
     )
     g2_gen = jnp.broadcast_to(
-        curve.g2_encode(ref.G2_GEN), (batch, 3, 2, 2, fp.NLIMB)
+        curve.g2_encode(ref.G2_GEN), (batch, 3, 2, fp.NLIMB)
     )
     h_proj = curve.g2_scalar_mul(g2_gen, bits)
     sk_bits = jnp.broadcast_to(

@@ -235,13 +235,16 @@ class BeaconHandler:
             blob = await queue.get()
             partials[self.scheme.index_of(blob)] = blob
 
-        sig = self.scheme.recover(
+        sig = await asyncio.to_thread(
+            self.scheme.recover,
             self.pub_poly, msg, list(partials.values()),
             self.group.threshold, len(self.group),
         )
         beacon = Beacon(round=round, prev_round=prev_round,
                         prev_sig=prev_sig, signature=sig)
-        verify_beacon(self.scheme, self.dist_key, beacon)
+        await asyncio.to_thread(
+            verify_beacon, self.scheme, self.dist_key, beacon
+        )
         # the head may have advanced while we were collecting (sync race)
         cur_head = self.store.last()
         if cur_head is not None and cur_head.round >= round:
@@ -261,18 +264,27 @@ class BeaconHandler:
 
     # -- inbound RPCs ------------------------------------------------------
 
-    async def process_beacon(self, packet: BeaconPacket) -> None:
-        """Inbound partial signature (reference ProcessBeacon :124-160)."""
+    def check_packet_window(self, packet: BeaconPacket) -> None:
+        """Cheap sanity gate: round must be near the clock's current round
+        (reference ProcessBeacon round checks, beacon.go:128-144)."""
         now = self.clock.now()
         cur = current_round(now, self.group.period, self.group.genesis_time)
-        # round sanity window: current, the next, or the previous round
         if packet.round < cur - 1 or packet.round > cur + 1:
             raise ValueError(
                 f"round {packet.round} out of window (current {cur})"
             )
+
+    async def process_beacon(self, packet: BeaconPacket) -> None:
+        """Inbound partial signature (reference ProcessBeacon :124-160)."""
+        self.check_packet_window(packet)
         msg = beacon_message(packet.prev_sig, packet.prev_round,
                              packet.round)
-        self.scheme.verify_partial(self.pub_poly, msg, packet.partial_sig)
+        # heavy pairing math runs off the event loop so the gRPC server
+        # keeps answering during verification
+        await asyncio.to_thread(
+            self.scheme.verify_partial, self.pub_poly, msg,
+            packet.partial_sig,
+        )
         idx = self.scheme.index_of(packet.partial_sig)
         if idx == self.index:
             return

@@ -414,9 +414,23 @@ class Drand:
         return f"drand_tpu node {self.pair.public.address} ({state})"
 
     async def process_beacon_packet(self, packet: BeaconPacket) -> None:
+        """Inbound partial: cheap window check inline, then ACK and verify
+        asynchronously.  Partial verification is ~pairing-level work; doing
+        it inside the RPC would blow the sender's deadline whenever several
+        partials land at once (the reference leans on goroutines here —
+        beacon.go:124 runs inside the per-RPC goroutine)."""
         if self.beacon is None:
             raise ValueError("beacon not running")
-        await self.beacon.process_beacon(packet)
+        self.beacon.check_packet_window(packet)
+
+        async def _ingest():
+            try:
+                await self.beacon.process_beacon(packet)
+            except Exception as exc:
+                log.debug("dropping partial from %s: %s",
+                          packet.from_address, exc)
+
+        asyncio.create_task(_ingest())
 
     def serve_sync_chain(self, from_round: int) -> List[Beacon]:
         if self.beacon is None:

@@ -26,7 +26,9 @@ from drand_tpu.net.tls import CertManager
 
 log = logging.getLogger("drand_tpu.net")
 
-RPC_TIMEOUT = 1.0       # reference beacon/beacon.go:89 per-RPC deadline
+# The reference uses a 1s per-RPC deadline (beacon/beacon.go:89); ours is
+# longer because peers may be busy in Python crypto on small hosts.
+RPC_TIMEOUT = 5.0
 CONTROL_TIMEOUT = 10.0
 
 PUBLIC_SERVICE = "drandtpu.Public"
@@ -400,7 +402,15 @@ class GrpcClient(ProtocolClient):
             previous_signature=packet.prev_sig,
             partial_signature=packet.partial_sig,
         )
-        await call(msg, timeout=RPC_TIMEOUT)
+        try:
+            await call(msg, timeout=RPC_TIMEOUT)
+        except grpc.aio.AioRpcError as exc:
+            if exc.code() == grpc.StatusCode.INVALID_ARGUMENT:
+                raise  # peer rejected the partial — no point retrying
+            # retry once (reference net/client_grpc.go:200-206): the peer
+            # may have been busy past the deadline
+            await asyncio.sleep(0.2)
+            await call(msg, timeout=RPC_TIMEOUT)
 
     async def sync_chain(self, peer: Identity,
                          from_round: int) -> AsyncIterator[Beacon]:
