@@ -100,19 +100,20 @@ for _k in range(6):
     _CONSTS[f"G2P{_k}"] = _mont_limbs(pow(ref._GAMMA2, _k, ref.P))
 
 _CONST_ORDER = list(_CONSTS.keys())
-#: (NL, K) int32 — column per constant
+#: (K, NL, 1) int32 — constants indexed on the LEADING dim so in-kernel
+#: reads carry no lane offset (lane-offset slices break Mosaic concats)
 CONSTS_NP = np.stack(
-    [np.array(_CONSTS[n], dtype=np.int32) for n in _CONST_ORDER], axis=1
-)
+    [np.array(_CONSTS[n], dtype=np.int32) for n in _CONST_ORDER], axis=0
+)[:, :, None]
 
-#: populated at kernel entry: {"consts": (NL,K) array}
+#: populated at kernel entry: {"consts": (K, NL, 1) array}
 _CTX = {}
 
 
 def _cc(name):
     """The (NL, 1) column of a registered constant."""
     i = _CONST_ORDER.index(name)
-    return _CTX["consts"][:, i : i + 1]
+    return _CTX["consts"][i]
 
 
 def _bit(name, i):
@@ -146,13 +147,18 @@ def _carry(x, out_len, passes=3):
     for _ in range(passes):
         hi = x >> BITS
         lo = x & MASK
+        # shift carries up one limb; the top limb keeps its own overflow
+        # in place.  (No .at[] updates: Mosaic lacks scatter; concat of
+        # static slices lowers cleanly.)
         shifted = jnp.concatenate(
-            [jnp.zeros_like(hi[:1]), hi[:top]], axis=0
+            [
+                jnp.zeros_like(hi[:1]),
+                hi[: top - 1],
+                hi[top - 1 : top] + (hi[top : top + 1] << BITS),
+            ],
+            axis=0,
         )
         x = lo + shifted
-        # keep top-limb overflow in place (positive static indices only:
-        # negative .at[] indices lower to dynamic_slice in Mosaic)
-        x = x.at[top : top + 1].add(hi[top : top + 1] << BITS)
     return x
 
 
@@ -170,12 +176,29 @@ def _fold_top(x, folds=1):
     return x
 
 
+def _padded(term, lo, width):
+    """`term` placed at row offset `lo` in a width-row zero array
+    (pure concat — no scatter)."""
+    parts = []
+    cols = term.shape[1]
+    if lo:
+        parts.append(jnp.zeros((lo, cols), jnp.int32))
+    parts.append(term)
+    tail = width - lo - term.shape[0]
+    if tail:
+        parts.append(jnp.zeros((tail, cols), jnp.int32))
+    if len(parts) == 1:
+        return term
+    return jnp.concatenate(parts, axis=0)
+
+
 def _conv(a, b):
     """Schoolbook product (NL,B)x(NL,B) -> (2*NL-1,B) columns."""
     width = 2 * NL - 1
-    t = jnp.zeros((width, a.shape[1]), jnp.int32)
+    t = None
     for j in range(NL):
-        t = t.at[j : j + NL].add(a * b[j : j + 1])
+        term = _padded(a * b[j : j + 1], j, width)
+        t = term if t is None else t + term
     return t
 
 
@@ -188,25 +211,28 @@ def _conv_const(a, limbs, width):
         hi = min(j + NL, width)
         if hi <= j:
             continue
-        if j == 0 and hi == width:
-            # full-range .at[] updates capture empty index constants in
-            # pallas tracing; a plain add is equivalent here
-            t = t + a[: hi - j] * c
-        else:
-            t = t.at[j:hi].add(a[: hi - j] * c)
+        t = t + _padded(a[: hi - j] * c, j, width)
     return t
 
 
 def f_mul(a, b):
     """Montgomery product; see ops/fp.py mont_mul for the bound analysis."""
+    # equalize lane widths up front: row slices of a 1-lane operand would
+    # otherwise broadcast in both dims at once (unsupported in Mosaic)
+    if a.shape[1] != b.shape[1]:
+        lanes = max(a.shape[1], b.shape[1])
+        a = jnp.broadcast_to(a, (a.shape[0], lanes))
+        b = jnp.broadcast_to(b, (b.shape[0], lanes))
     a = _carry(a, NL)
     b = _carry(b, NL)
     t = _conv(a, b)
     t = _carry(t, 2 * NL + 1)
     m = _conv_const(t[:NL], NP_L, NL)
     m = _carry(m, NL)
-    # mod R: mask top-limb overflow (static positive index)
-    m = m.at[NL - 1 : NL].set(m[NL - 1 : NL] & MASK)
+    # mod R: mask top-limb overflow
+    m = jnp.concatenate(
+        [m[: NL - 1], m[NL - 1 :] & MASK], axis=0
+    )
     mp = _conv_const(m, P_L, 2 * NL - 1)
     s = t + jnp.concatenate(
         [mp, jnp.zeros((2, mp.shape[1]), jnp.int32)], axis=0
@@ -214,7 +240,7 @@ def f_mul(a, b):
     s = _carry(s, 2 * NL + 1)
     c = jnp.any(s[:NL] != 0, axis=0, keepdims=True).astype(jnp.int32)
     out = s[NL : 2 * NL]
-    out = out.at[0:1].add(c)
+    out = jnp.concatenate([out[0:1] + c, out[1:]], axis=0)
     return out
 
 
@@ -579,7 +605,13 @@ def _exact_carry_signed(x):
 
 def _from_mont(a):
     """REDC(a) to the plain value, canonical limbs in [0, 2^12)."""
-    one = jnp.zeros((NL, a.shape[1]), jnp.int32).at[0].set(1)
+    one = jnp.concatenate(
+        [
+            jnp.ones((1, a.shape[1]), jnp.int32),
+            jnp.zeros((NL - 1, a.shape[1]), jnp.int32),
+        ],
+        axis=0,
+    )
     v = f_mul(a, one)
     d = _exact_carry_signed(v - _cc("P"))
     neg = d[NL : NL + 1] < 0
@@ -592,33 +624,12 @@ def _from_mont(a):
 # ---------------------------------------------------------------------------
 
 
-def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
-    """Batched product check over one block.
-
-    consts_ref: (NL, K) VMEM — limb constants (column per name)
-    p_ref: (2, 2, NL, B)     [pair, x/y, limb, lane]     G1 affine
-    q_ref: (2, 2, 2, NL, B)  [pair, x/y, u-comp, limb, lane] G2 affine
-    out_ref: (1, B) int32 — 1 where e(P1,Q1)*e(P2,Q2) == 1.
-    """
-    _CTX["consts"] = consts_ref[:]
-
-    b = p_ref.shape[-1]
-    b2 = 2 * b
-    px = jnp.concatenate([p_ref[0, 0], p_ref[1, 0]], axis=-1)
-    py = jnp.concatenate([p_ref[0, 1], p_ref[1, 1]], axis=-1)
-    xq = (
-        jnp.concatenate([q_ref[0, 0, 0], q_ref[1, 0, 0]], axis=-1),
-        jnp.concatenate([q_ref[0, 0, 1], q_ref[1, 0, 1]], axis=-1),
-    )
-    yq = (
-        jnp.concatenate([q_ref[0, 1, 0], q_ref[1, 1, 0]], axis=-1),
-        jnp.concatenate([q_ref[0, 1, 1], q_ref[1, 1, 1]], axis=-1),
-    )
-
-    f_stack0 = _fp12_to_stack(fp12_one(b2))
+def _miller(px, py, xq, yq, b):
+    """One batched Miller loop (fori over the static bit pattern)."""
+    f_stack0 = _fp12_to_stack(fp12_one(b))
     t_stack0 = jnp.stack(
         [xq[0], xq[1], yq[0], yq[1]]
-        + [fp2_one(b2)[0], fp2_one(b2)[1]],
+        + [fp2_one(b)[0], fp2_one(b)[1]],
         axis=0,
     )
 
@@ -628,10 +639,10 @@ def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
         tcur = ((ts[0], ts[1]), (ts[2], ts[3]), (ts[4], ts[5]))
         a2, bb2, c2 = _line_dbl(tcur, px, py)
         tnew = point_double2(tcur)
-        fnew = fp12_mul(fp12_sqr(fcur), _sparse12(a2, bb2, c2, b2))
+        fnew = fp12_mul(fp12_sqr(fcur), _sparse12(a2, bb2, c2, b))
         a2, bb2, c2 = _line_add(tnew, xq, yq, px, py)
-        tadd = point_add2(tnew, (xq, yq, fp2_one(b2)))
-        fadd = fp12_mul(fnew, _sparse12(a2, bb2, c2, b2))
+        tadd = point_add2(tnew, (xq, yq, fp2_one(b)))
+        fadd = fp12_mul(fnew, _sparse12(a2, bb2, c2, b))
         sel = _bit("MILLER", i) != 0
         fs_out = jnp.where(
             sel, _fp12_to_stack(fadd), _fp12_to_stack(fnew)
@@ -648,11 +659,37 @@ def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
     fs, _ = lax.fori_loop(
         0, BIT_LEN["MILLER"], mil_body, (f_stack0, t_stack0)
     )
-    f = fp12_conj(_stack_to_fp12(fs))  # x < 0
+    return fp12_conj(_stack_to_fp12(fs))  # x < 0
 
-    # product of the two pairing halves (lane split)
-    f1 = jax.tree.map(lambda a: a[:, :b], f)
-    f2 = jax.tree.map(lambda a: a[:, b:], f)
+
+def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
+    """Batched product check over one block.
+
+    consts_ref: (K, NL, 1) VMEM — limb constants (leading-dim indexed)
+    p_ref: (4 * NL, B)   G1 affine rows [p1.x | p1.y | p2.x | p2.y]
+    q_ref: (8 * NL, B)   G2 affine rows [q1.x.c0 | q1.x.c1 | q1.y.c0 |
+                         q1.y.c1 | q2...]
+    out_ref: (8, B) int32 — row 0 holds the verdict (padded to the int32
+                         min sublane tile).
+
+    The two Miller loops run sequentially on single-width batches —
+    doubling lanes and splitting mid-kernel trips Mosaic layout bugs.
+    """
+    _CTX["consts"] = consts_ref[:]
+
+    b = p_ref.shape[-1]
+    f1 = _miller(
+        p_ref[0 * NL : 1 * NL], p_ref[1 * NL : 2 * NL],
+        (q_ref[0 * NL : 1 * NL], q_ref[1 * NL : 2 * NL]),
+        (q_ref[2 * NL : 3 * NL], q_ref[3 * NL : 4 * NL]),
+        b,
+    )
+    f2 = _miller(
+        p_ref[2 * NL : 3 * NL], p_ref[3 * NL : 4 * NL],
+        (q_ref[4 * NL : 5 * NL], q_ref[5 * NL : 6 * NL]),
+        (q_ref[6 * NL : 7 * NL], q_ref[7 * NL : 8 * NL]),
+        b,
+    )
     g = fp12_mul(f1, f2)
 
     # final exponentiation (cubed; see ops/pairing.py)
@@ -676,10 +713,11 @@ def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
             for comp in range(2):
                 v = _from_mont(e[j][i][comp])
                 if first:
-                    v = v.at[0:1].add(-1)  # expect exactly 1 there
+                    # expect exactly 1 in the leading limb
+                    v = jnp.concatenate([v[0:1] - 1, v[1:]], axis=0)
                     first = False
                 ok = ok & jnp.all(v == 0, axis=0, keepdims=True)
-    out_ref[:] = ok.astype(jnp.int32)
+    out_ref[:] = jnp.broadcast_to(ok, (8, b)).astype(jnp.int32)
     _CTX.clear()
 
 
@@ -706,36 +744,40 @@ def pairing_product_check(p1, q1, p2, q2, block: int = 128,
             )
         p1, q1, p2, q2 = map(padder, (p1, q1, p2, q2))
     n = p1.shape[0]
-
-    p_all = jnp.stack(
-        [jnp.moveaxis(p1, 0, -1), jnp.moveaxis(p2, 0, -1)], axis=0
-    )  # (2, 2, NL, n)
-    q_all = jnp.stack(
-        [jnp.moveaxis(q1, 0, -1), jnp.moveaxis(q2, 0, -1)], axis=0
-    )  # (2, 2, 2, NL, n)
-
     grid = n // block
-    nconst = CONSTS_NP.shape[1]
+
+    def rows_g1(p):
+        # (n, 2, NL) -> (2*NL, n): rows [x limbs | y limbs]
+        return jnp.moveaxis(p, 0, -1).reshape(2 * NL, n)
+
+    def rows_g2(q):
+        # (n, 2, 2, NL) -> (4*NL, n): rows [x.c0 | x.c1 | y.c0 | y.c1]
+        return jnp.moveaxis(q, 0, -1).reshape(4 * NL, n)
+
+    p_all = jnp.concatenate([rows_g1(p1), rows_g1(p2)], axis=0)
+    q_all = jnp.concatenate([rows_g2(q1), rows_g2(q2)], axis=0)
+
+    nconst = CONSTS_NP.shape[0]
     out = pl.pallas_call(
         _check_kernel,
-        out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((8, n), jnp.int32),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec(
-                (NL, nconst), lambda i: (0, 0),
+                (nconst, NL, 1), lambda i: (0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (2, 2, NL, block), lambda i: (0, 0, 0, i),
+                (4 * NL, block), lambda i: (0, i),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (2, 2, 2, NL, block), lambda i: (0, 0, 0, 0, i),
+                (8 * NL, block), lambda i: (0, i),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, block), lambda i: (0, i), memory_space=pltpu.VMEM
+            (8, block), lambda i: (0, i), memory_space=pltpu.VMEM
         ),
         interpret=interpret,
     )(jnp.asarray(CONSTS_NP), p_all, q_all)

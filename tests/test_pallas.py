@@ -1,0 +1,155 @@
+"""Pallas kernel building blocks vs the oracle (interpreter mode).
+
+The full mega-kernel is exercised on real TPU hardware (bench.py path);
+here the in-kernel field/tower/point primitives run under the Pallas
+interpreter on CPU at tiny batch sizes.  The full-check interpreter run is
+too slow for CI, so coverage is compositional: every layer the kernel is
+built from is checked against the same oracle as the op-graph path.
+"""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.ops import fp
+from drand_tpu.ops import pallas_pairing as pp
+
+rng = random.Random(0xA11A)
+B = 4
+
+
+def col(x: int) -> np.ndarray:
+    return fp.int_to_limbs(x * fp.R_MONT % ref.P)
+
+
+def decode(limb_col) -> int:
+    return fp.limbs_to_int(np.asarray(limb_col)) % ref.P
+
+
+def run_rows(fn, out_rows, *arrays):
+    """Run `fn` over VMEM inputs inside an interpreted pallas kernel."""
+
+    def kern(consts_ref, *refs):
+        out_ref = refs[-1]
+        ins = [r[:] for r in refs[:-1]]
+        pp._CTX["consts"] = consts_ref[:]
+        out_ref[:] = fn(*ins)
+        pp._CTX.clear()
+
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((out_rows, B), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)]
+        * (1 + len(arrays)),
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=True,
+    )(jnp.asarray(pp.CONSTS_NP), *arrays)
+
+
+def rand_cols(n=B):
+    xs = [rng.randrange(ref.P) for _ in range(n)]
+    return xs, jnp.asarray(np.stack([col(x) for x in xs], axis=1))
+
+
+def test_field_ops_vs_oracle():
+    xs, a = rand_cols()
+    ys, b = rand_cols()
+    out = run_rows(pp.f_mul, pp.NL, a, b)
+    assert [decode(np.asarray(out)[:, i]) for i in range(B)] == [
+        x * y * fp.R_MONT % ref.P for x, y in zip(xs, ys)
+    ]
+    out = run_rows(pp.f_sub, pp.NL, a, b)
+    assert [decode(np.asarray(out)[:, i]) for i in range(B)] == [
+        (x - y) * fp.R_MONT % ref.P for x, y in zip(xs, ys)
+    ]
+    out = run_rows(lambda u: pp.f_muls(u, 3), pp.NL, a)
+    assert [decode(np.asarray(out)[:, i]) for i in range(B)] == [
+        3 * x * fp.R_MONT % ref.P for x in xs
+    ]
+
+
+def test_inv_and_exact_carry():
+    xs, a = rand_cols()
+    out = run_rows(lambda u: pp.f_mul(pp.f_inv(u), u), pp.NL, a)
+    assert all(
+        decode(np.asarray(out)[:, i]) == fp.R_MONT % ref.P
+        for i in range(B)
+    )
+    # _from_mont canonicalizes exactly
+    out = run_rows(pp._from_mont, pp.NL, a)
+    arr = np.asarray(out)
+    for i in range(B):
+        v = fp.limbs_to_int(arr[:, i])
+        assert v == xs[i] and arr[:, i].max() < (1 << pp.BITS)
+
+
+def test_fp2_mul_and_point_double_vs_oracle():
+    x2 = [(rng.randrange(ref.P), rng.randrange(ref.P)) for _ in range(B)]
+    y2 = [(rng.randrange(ref.P), rng.randrange(ref.P)) for _ in range(B)]
+
+    def pack2(vals):
+        return jnp.asarray(np.concatenate(
+            [np.stack([col(v[0]) for v in vals], axis=1),
+             np.stack([col(v[1]) for v in vals], axis=1)], axis=0
+        ))
+
+    A, Bb = pack2(x2), pack2(y2)
+
+    def k2(u, v):
+        r = pp.fp2_mul((u[: pp.NL], u[pp.NL :]), (v[: pp.NL], v[pp.NL :]))
+        return jnp.concatenate(r, axis=0)
+
+    out = np.asarray(run_rows(k2, 2 * pp.NL, A, Bb))
+    for i in range(B):
+        got = (decode(out[: pp.NL, i]), decode(out[pp.NL :, i]))
+        w = ref.fp2_mul(x2[i], y2[i])
+        assert got == (w[0] * fp.R_MONT % ref.P, w[1] * fp.R_MONT % ref.P)
+
+    # twist point doubling against the oracle
+    k = rng.randrange(1, ref.R)
+    pt = ref.g2_mul(ref.G2_GEN, k)
+    px = pack2([pt[0]] * B)
+    py = pack2([pt[1]] * B)
+    pz = pack2([(1, 0)] * B)
+
+    def kdbl(u, v, w):
+        t = (
+            (u[: pp.NL], u[pp.NL :]),
+            (v[: pp.NL], v[pp.NL :]),
+            (w[: pp.NL], w[pp.NL :]),
+        )
+        x3, y3, z3 = pp.point_double2(t)
+        return jnp.concatenate(list(x3 + y3 + z3), axis=0)
+
+    out = np.asarray(run_rows(kdbl, 6 * pp.NL, px, py, pz))
+    zx = (decode(out[0 * pp.NL : 1 * pp.NL, 0]),
+          decode(out[1 * pp.NL : 2 * pp.NL, 0]))
+    zy = (decode(out[2 * pp.NL : 3 * pp.NL, 0]),
+          decode(out[3 * pp.NL : 4 * pp.NL, 0]))
+    zz = (decode(out[4 * pp.NL : 5 * pp.NL, 0]),
+          decode(out[5 * pp.NL : 6 * pp.NL, 0]))
+    rinv = pow(fp.R_MONT, -1, ref.P)
+    unm = lambda c: (c[0] * rinv % ref.P, c[1] * rinv % ref.P)
+    zx, zy, zz = unm(zx), unm(zy), unm(zz)
+    # projective -> affine over the oracle field
+    zinv = ref.fp2_inv(zz)
+    aff = (ref.fp2_mul(zx, zinv), ref.fp2_mul(zy, zinv))
+    want = ref.g2_add(pt, pt)
+    assert aff == want
+
+
+def test_bit_patterns_match():
+    # the packed-word arithmetic bit reader must reproduce the patterns
+    for name, bits in pp._BITS_PARTS.items():
+        nbits = pp.BIT_LEN[name]
+        words = pp.BIT_WORDS[name]
+        for i in random.Random(3).sample(range(nbits), min(24, nbits)):
+            pos = nbits - 1 - i
+            got = (words[pos >> 4] >> (pos & 15)) & 1
+            assert got == int(bits[i]), (name, i)
