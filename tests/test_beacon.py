@@ -33,6 +33,26 @@ from drand_tpu.utils.clock import FakeClock
 PERIOD = 30.0
 
 
+async def wait_for_round(handlers, rnd, timeout=120.0):
+    """Wait (real time) until every handler's chain head reaches `rnd`.
+
+    Round completion involves real worker threads (asyncio.to_thread for
+    the pairing math), so advancing the fake clock alone does not imply
+    the round has been recovered and stored yet.
+    """
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        heads = [h.store.last() for h in handlers]
+        if all(b is not None and b.round >= rnd for b in heads):
+            return
+        await asyncio.sleep(0.02)
+    raise TimeoutError(
+        f"round {rnd} not reached: heads="
+        f"{[b.round if b else None for b in (h.store.last() for h in handlers)]}"
+    )
+
+
 class LocalNet(ProtocolClient):
     """In-process loopback transport between handlers."""
 
@@ -121,9 +141,11 @@ async def test_beacon_simple_rounds():
     for h in handlers:
         await h.start()
     await clock.advance(10)        # reach genesis -> round 1
-    await asyncio.sleep(0)
+    await wait_for_round(handlers, 1)
     await clock.advance(PERIOD)    # round 2
+    await wait_for_round(handlers, 2)
     await clock.advance(PERIOD)    # round 3
+    await wait_for_round(handlers, 3)
 
     dist_key = ref.g1_mul(ref.G1_GEN, poly.secret())
     scheme = tbls.RefScheme()
@@ -153,8 +175,11 @@ async def test_beacon_threshold_with_down_node_and_catchup():
     for h in handlers[:3]:
         await h.start()
     await clock.advance(10)
+    await wait_for_round(handlers[:3], 1)
     await clock.advance(PERIOD)
+    await wait_for_round(handlers[:3], 2)
     await clock.advance(PERIOD)
+    await wait_for_round(handlers[:3], 3)
     for h in handlers[:3]:
         assert h.store.last().round >= 2
 
@@ -169,6 +194,7 @@ async def test_beacon_threshold_with_down_node_and_catchup():
         verify_beacon(tbls.RefScheme(), dist_key, late.store.get(rnd))
     # and it now participates in new rounds
     await clock.advance(PERIOD)
+    await wait_for_round([late], head.round + 1)
     assert late.store.last().round >= 3
     for h in handlers:
         await h.stop()
@@ -181,10 +207,12 @@ async def test_sync_rejects_tampered_chain():
     for h in handlers[:3]:
         await h.start()
     await clock.advance(10)
+    await wait_for_round(handlers[:3], 1)
     await clock.advance(PERIOD)
+    await wait_for_round(handlers[:3], 2)
 
     # corrupt node 0's stored chain, then have node 3 sync only from it
-    b2 = handlers[0].store.get(2) or handlers[0].store.get(1)
+    b2 = handlers[0].store.get(2)
     bad = Beacon(b2.round, b2.prev_round, b2.prev_sig,
                  b2.signature[:-1] + bytes([b2.signature[-1] ^ 1]))
     handlers[0].store.put(bad)
