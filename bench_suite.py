@@ -1,0 +1,218 @@
+"""Full benchmark matrix over the BASELINE.md configs.
+
+`bench.py` prints the single headline line the driver records; this suite
+covers every configuration in BASELINE.json, one JSON line each:
+
+  demo-3of5      one full round (sign -> verify partials -> recover ->
+                 verify) on device, checked against the pure-Python oracle
+  chain-10k      batch-verify 10k historical rounds (chunked device calls)
+  67of100        batched partial verification + Lagrange-MSM recovery at
+                 League-of-Entropy scale
+  667of1000      large-committee MSM recovery
+  256chains      256 independent chain verifications, sharded over the
+                 available device mesh (data-parallel axis)
+
+Environment knobs: BENCH_BATCH (default 512), BENCH_CHAIN_N (default
+10240), BENCH_SUITE (comma-separated subset of the names above).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _emit(name: str, seconds: float, items: int, unit: str, extra=None):
+    out = {
+        "config": name,
+        "value": round(items / seconds, 2),
+        "unit": unit,
+        "seconds": round(seconds, 4),
+        "items": items,
+    }
+    if extra:
+        out.update(extra)
+    print(json.dumps(out), flush=True)
+
+
+def bench_demo_3of5() -> None:
+    """One-round tBLS parity: device round must equal the oracle round."""
+    from drand_tpu.beacon.chain import beacon_message
+    from drand_tpu.crypto import tbls
+    from drand_tpu.crypto.poly import PriPoly
+
+    poly = PriPoly.random(3, secret=0xDEC0DE)
+    shares = [poly.eval(i) for i in range(5)]
+    pub = poly.commit()
+    dist = pub.commits[0]
+    msg = beacon_message(b"genesis-seed", 0, 1)
+
+    jax_s = tbls.JaxScheme()
+    ref_s = tbls.RefScheme()
+
+    t0 = time.perf_counter()
+    partials = [jax_s.partial_sign(s, msg) for s in shares]
+    oks = jax_s.verify_partials_batch(pub, msg, partials)
+    assert all(oks), "device partial verification failed"
+    sig = jax_s.recover(pub, msg, partials[:3], 3, 5)
+    jax_s.verify_recovered(dist, msg, sig)
+    dt = time.perf_counter() - t0
+
+    # parity with the oracle (deterministic BLS: identical bytes)
+    want = ref_s.recover(pub, msg, ref_s_partials(ref_s, shares, msg), 3, 5)
+    assert sig == want, "device signature != oracle signature"
+    _emit("demo-3of5", dt, 1, "rounds/sec", {"parity": "ok"})
+
+
+def ref_s_partials(ref_s, shares, msg):
+    return [ref_s.partial_sign(s, msg) for s in shares[:3]]
+
+
+def _chain_args(batch: int):
+    import jax.numpy as jnp
+
+    from drand_tpu.crypto import refimpl as ref
+    from drand_tpu.ops import curve, fp
+
+    sk = 0x1234567890ABCDEF1234567890ABCDEF % ref.R
+    pk = ref.g1_mul(ref.G1_GEN, sk)
+    neg_g = ref.g1_neg(ref.G1_GEN)
+    rng = np.random.default_rng(7)
+    scalars = [int(rng.integers(1, 1 << 62)) for _ in range(batch)]
+    bits = jnp.asarray(np.stack([curve.scalar_to_bits(s) for s in scalars]))
+    g2 = jnp.broadcast_to(
+        curve.g2_encode(ref.G2_GEN), (batch, 3, 2, fp.NLIMB)
+    )
+    h = curve.g2_scalar_mul(g2, bits)
+    skb = jnp.broadcast_to(
+        jnp.asarray(curve.scalar_to_bits(sk)), (batch, 256)
+    )
+    sig = curve.g2_scalar_mul(h, skb)
+
+    def aff(p):
+        x, y = curve.g2_to_affine(p)
+        return jnp.stack([x, y], axis=1)
+
+    def enc_g1(pt):
+        return jnp.stack([fp.fp_encode(pt[0]), fp.fp_encode(pt[1])])
+
+    p1 = jnp.broadcast_to(enc_g1(neg_g), (batch, 2, fp.NLIMB))
+    p2 = jnp.broadcast_to(enc_g1(pk), (batch, 2, fp.NLIMB))
+    return p1, aff(sig), p2, aff(h)
+
+
+def bench_chain(n_rounds: int, batch: int) -> None:
+    import jax
+
+    from drand_tpu.ops import pairing
+
+    p1, q1, p2, q2 = _chain_args(batch)
+    fn = jax.jit(pairing.pairing_product_check)
+    ok = np.asarray(fn(p1, q1, p2, q2))
+    assert ok.all(), "warmup verification failed"
+    iters = max(1, n_rounds // batch)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(p1, q1, p2, q2)
+    np.asarray(r)
+    dt = time.perf_counter() - t0
+    _emit(
+        "chain-10k", dt, iters * batch, "rounds/sec",
+        {"pairings_per_sec": round(2 * iters * batch / dt, 1),
+         "batch": batch},
+    )
+
+
+def _committee(t: int, n: int, name: str) -> None:
+    """Batched partial verify + MSM recovery at committee scale."""
+    from drand_tpu.beacon.chain import beacon_message
+    from drand_tpu.crypto import tbls
+    from drand_tpu.crypto.poly import PriPoly
+
+    poly = PriPoly.random(t, secret=0xFEED + t)
+    shares = [poly.eval(i) for i in range(n)]
+    pub = poly.commit()
+    msg = beacon_message(b"committee-bench", 41, 42)
+    scheme = tbls.JaxScheme()
+
+    partials = [scheme.partial_sign(s, msg) for s in shares]
+
+    t0 = time.perf_counter()
+    oks = scheme.verify_partials_batch(pub, msg, partials)
+    t_verify = time.perf_counter() - t0
+    assert all(oks)
+
+    t0 = time.perf_counter()
+    sig = scheme.recover(pub, msg, partials[:t], t, n)
+    t_recover = time.perf_counter() - t0
+    scheme.verify_recovered(pub.commits[0], msg, sig)
+    _emit(
+        name, t_verify, n, "partial-verifies/sec",
+        {"recover_seconds": round(t_recover, 4),
+         "threshold": t, "nodes": n},
+    )
+
+
+def bench_256chains(batch_per_chain: int = 8) -> None:
+    """256 independent chains sharded across the device mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from drand_tpu.ops import pairing
+
+    devices = jax.devices()
+    nd = max(
+        d for d in range(1, len(devices) + 1) if 256 % d == 0
+    )
+    mesh = Mesh(np.asarray(devices[:nd]), axis_names=("chains",))
+    shard = NamedSharding(mesh, P("chains"))
+
+    chains = 256
+    p1, q1, p2, q2 = _chain_args(chains)
+    args = [jax.device_put(x, shard) for x in (p1, q1, p2, q2)]
+    fn = jax.jit(
+        pairing.pairing_product_check,
+        in_shardings=(shard,) * 4,
+        out_shardings=shard,
+    )
+    ok = np.asarray(fn(*args))
+    assert ok.all()
+    iters = 4
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args)
+    np.asarray(r)
+    dt = time.perf_counter() - t0
+    _emit(
+        "256chains", dt, iters * chains, "chain-heads/sec",
+        {"devices": nd},
+    )
+
+
+def main() -> None:
+    batch = int(os.environ.get("BENCH_BATCH", "512"))
+    chain_n = int(os.environ.get("BENCH_CHAIN_N", "10240"))
+    only = os.environ.get("BENCH_SUITE")
+    wanted = set(only.split(",")) if only else None
+
+    def want(name: str) -> bool:
+        return wanted is None or name in wanted
+
+    if want("demo-3of5"):
+        bench_demo_3of5()
+    if want("chain-10k"):
+        bench_chain(chain_n, batch)
+    if want("67of100"):
+        _committee(67, 100, "67of100")
+    if want("667of1000"):
+        _committee(667, 1000, "667of1000")
+    if want("256chains"):
+        bench_256chains()
+
+
+if __name__ == "__main__":
+    main()

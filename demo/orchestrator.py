@@ -1,0 +1,269 @@
+"""Process-level orchestrator: real daemons, real clock, full lifecycle.
+
+Mirrors /root/reference/demo/orchestrator.go + demo/node.go: spawn real
+`drand_tpu.cli` daemons as subprocesses, build the group file, drive the
+DKG through the control ports, fetch verified beacons each period, kill
+and restart nodes, stop/restart the whole network, and reshare to a new
+group — asserting chain continuity throughout (reference scenario
+demo/main.go:28-109).
+
+Usage:  python demo/main.py  (see main.py for the scenario).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import time
+import tomllib
+from pathlib import Path
+from typing import Dict, List, Optional
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def free_ports(n: int) -> List[int]:
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class Node:
+    """One drand-tpu daemon process (reference demo/node.go:42)."""
+
+    def __init__(self, index: int, base: Path, port: int, ctrl: int,
+                 rest_port: Optional[int] = None):
+        self.index = index
+        self.folder = base / f"node{index}"
+        self.addr = f"127.0.0.1:{port}"
+        self.ctrl = ctrl
+        self.rest_port = rest_port
+        self.proc: Optional[subprocess.Popen] = None
+        self.log = base / f"node{index}.log"
+
+    # -- CLI helpers ------------------------------------------------------
+
+    def _env(self) -> Dict[str, str]:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(REPO)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    def cli(self, *args: str, timeout: float = 180.0,
+            check: bool = True) -> subprocess.CompletedProcess:
+        r = subprocess.run(
+            [sys.executable, "-m", "drand_tpu.cli",
+             "--folder", str(self.folder), "--control", str(self.ctrl),
+             *args],
+            capture_output=True, text=True, timeout=timeout,
+            env=self._env(),
+        )
+        if check and r.returncode != 0:
+            raise RuntimeError(
+                f"node{self.index} cli {args} failed:\n"
+                f"{r.stdout}\n{r.stderr}"
+            )
+        return r
+
+    def cli_async(self, *args: str) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "drand_tpu.cli",
+             "--folder", str(self.folder), "--control", str(self.ctrl),
+             *args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=self._env(),
+        )
+
+    # -- lifecycle --------------------------------------------------------
+
+    def keygen(self) -> Path:
+        self.cli("generate-keypair", self.addr)
+        return self.folder / "key" / "public.toml"
+
+    def start(self) -> None:
+        assert self.proc is None or self.proc.poll() is not None
+        args = [sys.executable, "-m", "drand_tpu.cli",
+                "--folder", str(self.folder), "--control", str(self.ctrl)]
+        if self.rest_port:
+            args += ["--rest-port", str(self.rest_port)]
+        args += ["start"]
+        logfh = open(self.log, "a")
+        self.proc = subprocess.Popen(
+            args, stdout=logfh, stderr=subprocess.STDOUT, text=True,
+            env=self._env(),
+        )
+
+    def wait_ready(self, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            r = self.cli("ping", check=False, timeout=20)
+            if r.returncode == 0:
+                return
+            time.sleep(0.5)
+        raise TimeoutError(f"node{self.index} did not become ready")
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Graceful stop through the control port."""
+        if self.proc is None:
+            return
+        self.cli("stop", check=False)
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.terminate()
+            self.proc.wait(timeout=10)
+        self.proc = None
+
+    def kill(self) -> None:
+        """Hard kill (fault injection, reference demo/main.go:60-90)."""
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+            self.proc = None
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class Orchestrator:
+    """Scenario driver (reference demo/orchestrator.go:44)."""
+
+    def __init__(self, n: int, base: Path, period: str = "20s",
+                 genesis_delay: int = 60):
+        self.base = base
+        self.period = period
+        self.period_s = float(period.rstrip("s"))
+        ports = free_ports(2 * n + 1)
+        self.nodes = [
+            Node(i, base, ports[i], ports[n + i],
+                 rest_port=ports[2 * n] if i == 0 else None)
+            for i in range(n)
+        ]
+        self.group_file = base / "group.toml"
+        self.genesis_delay = genesis_delay
+        self.genesis: Optional[int] = None
+        self.dist_key_hex: Optional[str] = None
+
+    # -- setup ------------------------------------------------------------
+
+    def setup_keys(self) -> None:
+        for node in self.nodes:
+            node.keygen()
+
+    def create_group(self, nodes: Optional[List[Node]] = None,
+                     threshold: Optional[int] = None) -> None:
+        nodes = nodes or self.nodes
+        pubs = [str(n.folder / "key" / "public.toml") for n in nodes]
+        self.genesis = int(time.time()) + self.genesis_delay
+        args = ["group", *pubs, "--period", self.period,
+                "--genesis", str(self.genesis),
+                "--out", str(self.group_file)]
+        if threshold:
+            args += ["--threshold", str(threshold)]
+        self.nodes[0].cli(*args)
+
+    def start_all(self) -> None:
+        for node in self.nodes:
+            node.start()
+        for node in self.nodes:
+            node.wait_ready()
+
+    def run_dkg(self, leader: Node, members: List[Node],
+                timeout: float = 300.0) -> str:
+        """Followers first, leader last (reference control.go:20)."""
+        waits = [
+            m.cli_async("share", str(self.group_file))
+            for m in members if m is not leader
+        ]
+        time.sleep(2)
+        lead = leader.cli("share", str(self.group_file), "--leader",
+                          timeout=timeout)
+        assert "distributed key:" in lead.stdout, lead.stdout
+        self.dist_key_hex = lead.stdout.split("distributed key:")[1].strip()
+        for p in waits:
+            out, _ = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                raise RuntimeError(f"share failed: {out}")
+        return self.dist_key_hex
+
+    def run_reshare(self, leader: Node, members: List[Node],
+                    new_group_file: Path, old_group_file: Path,
+                    retiring: List[Node],
+                    timeout: float = 300.0) -> None:
+        """Resharing: every old ∪ new node runs `share --reshare`."""
+        waits = []
+        for m in members + retiring:
+            if m is leader:
+                continue
+            waits.append(m.cli_async(
+                "share", str(new_group_file), "--reshare",
+                "--from-group", str(old_group_file),
+            ))
+        time.sleep(2)
+        leader.cli("share", str(new_group_file), "--leader", "--reshare",
+                   "--from-group", str(old_group_file), timeout=timeout)
+        for p in waits:
+            out, _ = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                raise RuntimeError(f"reshare share failed: {out}")
+
+    # -- assertions -------------------------------------------------------
+
+    def fetch_beacon(self, via: Node, round: int = 0,
+                     timeout: float = 60.0) -> dict:
+        """Fetch + client-side-verify a beacon through a node."""
+        deadline = time.monotonic() + timeout
+        last_err = ""
+        while time.monotonic() < deadline:
+            r = via.cli(
+                "get", "public", str(self.group_file),
+                "--node", via.addr, "--round", str(round),
+                "--distkey", self.dist_key_hex or "",
+                check=False,
+            )
+            if r.returncode == 0 and "Randomness" in r.stdout:
+                out = {}
+                for line in r.stdout.splitlines():
+                    if "=" in line:
+                        k, v = line.split("=", 1)
+                        out[k.strip()] = v.strip().strip('"')
+                return out
+            last_err = r.stdout + r.stderr
+            time.sleep(2)
+        raise TimeoutError(
+            f"no beacon for round {round} via node{via.index}: {last_err}"
+        )
+
+    def wait_round(self, rnd: int, via: Node,
+                   timeout: float = 300.0) -> dict:
+        """Wait until `rnd` exists, verifying it on fetch."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                return self.fetch_beacon(via, rnd, timeout=10)
+            except TimeoutError:
+                time.sleep(self.period_s / 4)
+        raise TimeoutError(f"round {rnd} never appeared")
+
+    # -- teardown ---------------------------------------------------------
+
+    def stop_all(self) -> None:
+        for node in self.nodes:
+            if node.alive():
+                node.stop()
+
+    def cleanup(self) -> None:
+        self.stop_all()
+        shutil.rmtree(self.base, ignore_errors=True)
+
+
+def load_group_toml(path: Path) -> dict:
+    with open(path, "rb") as fh:
+        return tomllib.load(fh)

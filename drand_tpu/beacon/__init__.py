@@ -14,5 +14,9 @@ from drand_tpu.beacon.chain import (  # noqa: F401
     time_of_round,
     verify_beacon,
 )
-from drand_tpu.beacon.store import BeaconStore, CallbackStore  # noqa: F401
+from drand_tpu.beacon.store import (  # noqa: F401
+    BeaconStore,
+    CallbackStore,
+    open_store,
+)
 from drand_tpu.beacon.handler import BeaconHandler, BeaconConfig  # noqa: F401

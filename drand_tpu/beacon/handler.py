@@ -41,9 +41,37 @@ from drand_tpu.beacon.round_cache import RoundManager
 from drand_tpu.beacon.store import BeaconStore, CallbackStore
 from drand_tpu.crypto import tbls
 from drand_tpu.key import Group, Identity, Share
+from drand_tpu.utils import metrics
 from drand_tpu.utils.clock import Clock
 
 log = logging.getLogger("drand_tpu.beacon")
+
+_rounds_total = metrics.counter(
+    "drand_beacon_rounds_total", "beacon rounds stored by this node"
+)
+_rounds_failed = metrics.counter(
+    "drand_beacon_rounds_failed_total",
+    "round attempts abandoned (ticker advanced or recovery failed)",
+)
+_partials_in = metrics.counter(
+    "drand_beacon_partials_received_total",
+    "partial signatures accepted from peers",
+)
+_partials_rejected = metrics.counter(
+    "drand_beacon_partials_rejected_total",
+    "inbound partial signatures rejected (window or verification)",
+)
+_sync_verified = metrics.counter(
+    "drand_beacon_sync_rounds_verified_total",
+    "historical rounds batch-verified during catch-up sync",
+)
+_round_seconds = metrics.histogram(
+    "drand_beacon_round_seconds",
+    "wall time from round start to stored beacon",
+)
+_head_gauge = metrics.gauge(
+    "drand_beacon_head_round", "chain head round of this node"
+)
 
 #: how many sync'd beacons to verify per device batch
 SYNC_BATCH = 64
@@ -210,6 +238,17 @@ class BeaconHandler:
             await self.clock.sleep(t_next - self.clock.now())
 
     async def _run_round(self, round: int) -> None:
+        try:
+            await self._run_round_inner(round)
+        except asyncio.CancelledError:
+            _rounds_failed.inc()  # ticker-is-king abandonment
+            raise
+        except Exception:
+            _rounds_failed.inc()  # recovery/verification failure
+            log.exception("round %s failed on node %s", round, self.index)
+
+    async def _run_round_inner(self, round: int) -> None:
+        t_start = asyncio.get_running_loop().time()
         head = self.store.last()
         if head is None or head.round >= round:
             return
@@ -248,8 +287,14 @@ class BeaconHandler:
         # the head may have advanced while we were collecting (sync race)
         cur_head = self.store.last()
         if cur_head is not None and cur_head.round >= round:
+            _rounds_failed.inc()
             return
         self.store.put(beacon)
+        _rounds_total.inc()
+        _head_gauge.set(round)
+        _round_seconds.observe(
+            asyncio.get_running_loop().time() - t_start
+        )
         log.debug("node %s stored round %s", self.index, round)
         if self._stop_at is not None and round >= self._stop_at:
             self._running = False
@@ -276,18 +321,23 @@ class BeaconHandler:
 
     async def process_beacon(self, packet: BeaconPacket) -> None:
         """Inbound partial signature (reference ProcessBeacon :124-160)."""
-        self.check_packet_window(packet)
-        msg = beacon_message(packet.prev_sig, packet.prev_round,
-                             packet.round)
-        # heavy pairing math runs off the event loop so the gRPC server
-        # keeps answering during verification
-        await asyncio.to_thread(
-            self.scheme.verify_partial, self.pub_poly, msg,
-            packet.partial_sig,
-        )
+        try:
+            self.check_packet_window(packet)
+            msg = beacon_message(packet.prev_sig, packet.prev_round,
+                                 packet.round)
+            # heavy pairing math runs off the event loop so the gRPC
+            # server keeps answering during verification
+            await asyncio.to_thread(
+                self.scheme.verify_partial, self.pub_poly, msg,
+                packet.partial_sig,
+            )
+        except Exception:
+            _partials_rejected.inc()
+            raise
         idx = self.scheme.index_of(packet.partial_sig)
         if idx == self.index:
             return
+        _partials_in.inc()
         self.manager.add_partial(packet.round, packet.partial_sig)
 
     def sync_chain_from(self, from_round: int) -> List[Beacon]:
@@ -350,6 +400,8 @@ class BeaconHandler:
         if not all(ok):
             bad = [batch[i].round for i, v in enumerate(ok) if not v]
             raise ValueError(f"invalid signatures at rounds {bad}")
+        _sync_verified.inc(len(batch))
         for b in batch:
             self.store.put(b)
+        _head_gauge.set(batch[-1].round)
         return batch[-1]

@@ -1,0 +1,173 @@
+"""ctypes binding for the native C++ chain store (native/chainstore.cc).
+
+Same interface as :class:`drand_tpu.beacon.store.BeaconStore` (the
+reference's boltdb store surface, /root/reference/beacon/store.go:22-45):
+``__len__ / put / get / last / cursor / range_from / close``.  Use
+:func:`available` to test whether the shared library could be built, and
+:func:`drand_tpu.beacon.store.open_store` to pick a backend.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import List, Optional
+
+from drand_tpu import native
+from drand_tpu.beacon.chain import Beacon
+
+_CAP = 4096  # signature buffer capacity (sigs are 96B; headroom is free)
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = native.shared_lib("chainstore")
+        if path is None:
+            raise RuntimeError(
+                f"native chainstore unavailable: {native.build_error()}"
+            )
+        lib = ctypes.CDLL(path)
+        lib.dtcs_open.restype = ctypes.c_void_p
+        lib.dtcs_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.dtcs_close.argtypes = [ctypes.c_void_p]
+        lib.dtcs_count.restype = ctypes.c_int64
+        lib.dtcs_count.argtypes = [ctypes.c_void_p]
+        lib.dtcs_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lookup = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
+        ]
+        nolookup = lookup[:1] + lookup[2:]
+        lib.dtcs_get.argtypes = lookup
+        lib.dtcs_seek.argtypes = lookup
+        lib.dtcs_first.argtypes = nolookup
+        lib.dtcs_last.argtypes = nolookup
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+class NativeBeaconStore:
+    def __init__(self, path: str = ":memory:", fsync_puts: bool = False):
+        lib = _load()
+        cpath = b"" if path == ":memory:" else path.encode()
+        self._h = lib.dtcs_open(cpath, 1 if fsync_puts else 0)
+        if not self._h:
+            raise RuntimeError(f"cannot open native chain store at {path}")
+        self._lib = lib
+
+    def __len__(self) -> int:
+        return int(self._lib.dtcs_count(self._h))
+
+    def put(self, b: Beacon) -> None:
+        rc = self._lib.dtcs_put(
+            self._h, b.round, b.prev_round,
+            b.prev_sig, len(b.prev_sig), b.signature, len(b.signature),
+        )
+        if rc != 0:
+            raise IOError(f"native store put failed (rc={rc})")
+
+    def _lookup(self, fn, *args) -> Optional[Beacon]:
+        rnd = ctypes.c_uint64()
+        prev = ctypes.c_uint64()
+        psl = ctypes.c_uint32(_CAP)
+        sl = ctypes.c_uint32(_CAP)
+        pbuf = ctypes.create_string_buffer(_CAP)
+        sbuf = ctypes.create_string_buffer(_CAP)
+        rc = fn(self._h, *args, ctypes.byref(rnd), ctypes.byref(prev),
+                pbuf, ctypes.byref(psl), sbuf, ctypes.byref(sl))
+        if rc == -1:
+            return None
+        if rc != 0:
+            raise IOError(f"native store lookup failed (rc={rc})")
+        return Beacon(
+            round=rnd.value, prev_round=prev.value,
+            prev_sig=pbuf.raw[: psl.value], signature=sbuf.raw[: sl.value],
+        )
+
+    def get(self, round: int) -> Optional[Beacon]:
+        return self._lookup(self._lib.dtcs_get, ctypes.c_uint64(round))
+
+    def _seek(self, round: int) -> Optional[Beacon]:
+        return self._lookup(self._lib.dtcs_seek, ctypes.c_uint64(round))
+
+    def first(self) -> Optional[Beacon]:
+        return self._lookup(self._lib.dtcs_first)
+
+    def last(self) -> Optional[Beacon]:
+        return self._lookup(self._lib.dtcs_last)
+
+    def cursor(self) -> "NativeCursor":
+        return NativeCursor(self)
+
+    def range_from(self, from_round: int,
+                   limit: Optional[int] = None) -> List[Beacon]:
+        out: List[Beacon] = []
+        rnd = from_round
+        while limit is None or len(out) < limit:
+            b = self._seek(rnd)
+            if b is None:
+                break
+            out.append(b)
+            rnd = b.round + 1
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.dtcs_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - GC ordering
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeCursor:
+    """Round-ordered cursor (reference store.go Cursor:40-45)."""
+
+    def __init__(self, store: NativeBeaconStore):
+        self._store = store
+        self._round: Optional[int] = None
+
+    def _note(self, b: Optional[Beacon]) -> Optional[Beacon]:
+        if b is not None:
+            self._round = b.round
+        return b
+
+    def first(self) -> Optional[Beacon]:
+        return self._note(self._store.first())
+
+    def last(self) -> Optional[Beacon]:
+        return self._note(self._store.last())
+
+    def seek(self, round: int) -> Optional[Beacon]:
+        return self._note(self._store._seek(round))
+
+    def next(self) -> Optional[Beacon]:
+        if self._round is None:
+            return self.first()
+        return self._note(self._store._seek(self._round + 1))

@@ -87,6 +87,27 @@ class BeaconStore:
             self._db.close()
 
 
+def open_store(path: str = ":memory:", backend: str = "auto",
+               fsync_puts: bool = True):
+    """Open a chain store: 'native' (C++ append-log), 'sqlite', or 'auto'
+    (native when the shared library builds, sqlite otherwise).
+
+    `fsync_puts` defaults on for durability parity with the sqlite
+    backend (and the reference's transactional boltdb Put,
+    beacon/store.go:103); pass False for throwaway test stores."""
+    if backend not in ("auto", "native", "sqlite"):
+        raise ValueError(f"unknown store backend {backend!r}")
+    if backend in ("auto", "native"):
+        try:
+            from drand_tpu.beacon.native_store import NativeBeaconStore
+
+            return NativeBeaconStore(path, fsync_puts=fsync_puts)
+        except (RuntimeError, OSError):
+            if backend == "native":
+                raise
+    return BeaconStore(path)
+
+
 class Cursor:
     """Iteration over the chain in round order (reference store.go:40-45)."""
 

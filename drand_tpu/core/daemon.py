@@ -29,6 +29,7 @@ from drand_tpu.beacon import (
     BeaconConfig,
     BeaconHandler,
     BeaconStore,
+    open_store,
     current_round,
     time_of_round,
 )
@@ -340,7 +341,7 @@ class Drand:
         # the chain store survives handler swaps (resharing must keep the
         # already-produced chain, especially for in-memory stores)
         if self._beacon_store is None:
-            self._beacon_store = BeaconStore(self._beacon_store_path())
+            self._beacon_store = open_store(self._beacon_store_path())
         self.beacon = BeaconHandler(bcfg, self._beacon_store, self._client)
         self.beacon.add_callback(self._fanout_beacon)
         if transition:

@@ -30,9 +30,19 @@ from drand_tpu.crypto.poly import (
     PubPoly,
     lagrange_basis_at_zero,
 )
+from drand_tpu.utils import metrics
 
 INDEX_LEN = 2
 SIG_LEN = 96
+
+_kernel_seconds = {
+    op: metrics.histogram(
+        "drand_device_kernel_seconds",
+        "wall time of device crypto kernel dispatches",
+        labels={"op": op},
+    )
+    for op in ("pairing_check", "msm_recover", "g2_sign")
+}
 
 
 class ThresholdError(Exception):
@@ -211,9 +221,14 @@ class JaxScheme(Scheme):
 
     def partial_sign(self, share: PriShare, msg: bytes) -> bytes:
         h = hash_to_sig_group(msg)
-        hq = self._curve.g2_encode(h)
-        bits = self._jnp.asarray(self._curve.scalar_to_bits(share.value))
-        sig = self._curve.g2_decode(self._curve.g2_scalar_mul(hq, bits))
+        with _kernel_seconds["g2_sign"].time():
+            hq = self._curve.g2_encode(h)
+            bits = self._jnp.asarray(
+                self._curve.scalar_to_bits(share.value)
+            )
+            sig = self._curve.g2_decode(
+                self._curve.g2_scalar_mul(hq, bits)
+            )
         return _pack_partial(share.index, sig)
 
     def verify_partial(self, pub: PubPoly, msg: bytes,
@@ -235,8 +250,10 @@ class JaxScheme(Scheme):
                 [self._curve.scalar_to_bits(lam[i]) for i, _ in chosen]
             )
         )
-        acc = self._msm.g2_msm(pts, bits)
-        return ref.g2_to_bytes(self._curve.g2_decode(acc))
+        with _kernel_seconds["msm_recover"].time():
+            acc = self._msm.g2_msm(pts, bits)
+            out = self._curve.g2_decode(acc)
+        return ref.g2_to_bytes(out)
 
     def verify_recovered(self, pub_key, msg: bytes, sig: bytes) -> None:
         ok = self.verify_chain_batch(pub_key, [msg], [sig])[0]
@@ -270,9 +287,10 @@ class JaxScheme(Scheme):
         q1 = self._jnp.stack([self._enc_g2(sigs[i]) for i in rows])
         p2 = self._jnp.stack([self._enc_g1(pks[i]) for i in rows])
         q2 = self._jnp.stack([self._enc_g2(h)] * nb)
-        ok = np.asarray(
-            self._pairing.pairing_product_check(p1, q1, p2, q2)
-        )
+        with _kernel_seconds["pairing_check"].time():
+            ok = np.asarray(
+                self._pairing.pairing_product_check(p1, q1, p2, q2)
+            )
         out = [False] * len(partials)
         for j, i in enumerate(live):
             out[i] = bool(ok[j])
@@ -302,9 +320,10 @@ class JaxScheme(Scheme):
         q1 = self._jnp.stack([self._enc_g2(pts[i]) for i in rows])
         p2 = self._jnp.stack([self._enc_g1(pub_key)] * nb)
         q2 = self._jnp.stack([self._enc_g2(hs[i]) for i in rows])
-        ok = np.asarray(
-            self._pairing.pairing_product_check(p1, q1, p2, q2)
-        )
+        with _kernel_seconds["pairing_check"].time():
+            ok = np.asarray(
+                self._pairing.pairing_product_check(p1, q1, p2, q2)
+            )
         out = [False] * len(sigs)
         for j, i in enumerate(live):
             out[i] = bool(ok[j])

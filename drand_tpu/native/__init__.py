@@ -1,0 +1,67 @@
+"""Native (C++) runtime components, built on demand with the system g++.
+
+The reference's runtime is native Go end to end; here the Python protocol
+plane delegates its storage hot path to a C++ embedded store
+(`chainstore.cc`), loaded through ctypes.  Build artifacts are cached next
+to the sources and rebuilt whenever a source file changes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_SRC_DIR = Path(__file__).resolve().parent
+_BUILD_DIR = _SRC_DIR / "_build"
+_LOCK = threading.Lock()
+_BUILD_ERROR: Optional[str] = None
+
+
+def _source_digest(src: Path) -> str:
+    return hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+
+
+def shared_lib(name: str) -> Optional[str]:
+    """Path to the built shared library for `name`.cc, compiling if
+    needed.  Returns None (and remembers why) if no compiler is usable —
+    callers fall back to their pure-Python/sqlite implementations."""
+    global _BUILD_ERROR
+    src = _SRC_DIR / f"{name}.cc"
+    tag = _source_digest(src)
+    out = _BUILD_DIR / f"{name}-{tag}.so"
+    if out.exists():
+        return str(out)
+    with _LOCK:
+        if out.exists():
+            return str(out)
+        if _BUILD_ERROR is not None:
+            return None
+        _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+        # per-pid temp name: concurrent daemon processes may race to
+        # build the same digest; os.replace makes the publish atomic
+        tmp = out.with_suffix(f".so.{os.getpid()}.tmp")
+        cmd = [
+            os.environ.get("CXX", "g++"),
+            "-O2", "-std=c++17", "-shared", "-fPIC",
+            str(src), "-o", str(tmp),
+        ]
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=120
+            )
+        except (OSError, subprocess.TimeoutExpired) as exc:
+            _BUILD_ERROR = f"{cmd[0]}: {exc}"
+            return None
+        if proc.returncode != 0:
+            _BUILD_ERROR = proc.stderr[-2000:]
+            return None
+        os.replace(tmp, out)
+    return str(out)
+
+
+def build_error() -> Optional[str]:
+    return _BUILD_ERROR

@@ -1,0 +1,274 @@
+// Native embedded beacon-chain store.
+//
+// TPU-native equivalent of the reference's boltdb beacon store
+// (/root/reference/beacon/store.go:22-45,62): an embedded, durable,
+// round-keyed store with ordered-cursor iteration, implemented as an
+// append-only record log plus an in-memory ordered index.  The daemon's
+// storage hot path (one Put per round, range scans for chain sync) stays
+// off the Python heap; Python talks to it through a small C ABI (ctypes).
+//
+// File format:
+//   header:  8 bytes magic "DTCSTOR1"
+//   record:  [u32 crc][u32 payload_len][payload]
+//   payload: [u64 round][u64 prev_round][u32 prev_sig_len][u32 sig_len]
+//            [prev_sig bytes][sig bytes]
+// crc32 covers the payload.  Records only append; a Put for an existing
+// round appends a superseding record (the index keeps the newest offset).
+// On open the log is scanned to rebuild the index; a torn tail record
+// (crash mid-write) fails its crc and the file is truncated there —
+// restart-safe by construction, mirroring the reference's transactional
+// Put (store.go:103).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'D', 'T', 'C', 'S', 'T', 'O', 'R', '1'};
+
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t crc32(const uint8_t* buf, size_t len) {
+  crc_init();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++)
+    c = crc_table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Record {
+  uint64_t round;
+  uint64_t prev_round;
+  std::vector<uint8_t> prev_sig;
+  std::vector<uint8_t> sig;
+};
+
+struct Store {
+  std::mutex mu;
+  int fd = -1;            // -1 => pure in-memory store
+  bool fsync_puts = false;
+  std::map<uint64_t, Record> index;  // round -> newest record
+};
+
+void put_u32(std::vector<uint8_t>& v, uint32_t x) {
+  for (int i = 0; i < 4; i++) v.push_back((x >> (8 * i)) & 0xFF);
+}
+void put_u64(std::vector<uint8_t>& v, uint64_t x) {
+  for (int i = 0; i < 8; i++) v.push_back((x >> (8 * i)) & 0xFF);
+}
+uint32_t get_u32(const uint8_t* p) {
+  uint32_t x = 0;
+  for (int i = 0; i < 4; i++) x |= uint32_t(p[i]) << (8 * i);
+  return x;
+}
+uint64_t get_u64(const uint8_t* p) {
+  uint64_t x = 0;
+  for (int i = 0; i < 8; i++) x |= uint64_t(p[i]) << (8 * i);
+  return x;
+}
+
+std::vector<uint8_t> encode_payload(const Record& r) {
+  std::vector<uint8_t> p;
+  p.reserve(24 + r.prev_sig.size() + r.sig.size());
+  put_u64(p, r.round);
+  put_u64(p, r.prev_round);
+  put_u32(p, uint32_t(r.prev_sig.size()));
+  put_u32(p, uint32_t(r.sig.size()));
+  p.insert(p.end(), r.prev_sig.begin(), r.prev_sig.end());
+  p.insert(p.end(), r.sig.begin(), r.sig.end());
+  return p;
+}
+
+bool decode_payload(const uint8_t* p, size_t len, Record* out) {
+  if (len < 24) return false;
+  out->round = get_u64(p);
+  out->prev_round = get_u64(p + 8);
+  uint32_t psl = get_u32(p + 16);
+  uint32_t sl = get_u32(p + 20);
+  if (24 + uint64_t(psl) + uint64_t(sl) != len) return false;
+  out->prev_sig.assign(p + 24, p + 24 + psl);
+  out->sig.assign(p + 24 + psl, p + 24 + psl + sl);
+  return true;
+}
+
+// Scan the log, rebuilding the index; truncate at the first bad record.
+bool load(Store* s) {
+  off_t size = lseek(s->fd, 0, SEEK_END);
+  if (size < 0) return false;
+  if (size == 0) {
+    if (pwrite(s->fd, kMagic, 8, 0) != 8) return false;
+    return true;
+  }
+  char magic[8];
+  if (pread(s->fd, magic, 8, 0) != 8 || memcmp(magic, kMagic, 8) != 0)
+    return false;
+  off_t off = 8;
+  std::vector<uint8_t> buf;
+  while (off + 8 <= size) {
+    uint8_t hdr[8];
+    if (pread(s->fd, hdr, 8, off) != 8) break;
+    uint32_t crc = get_u32(hdr);
+    uint32_t len = get_u32(hdr + 4);
+    if (len > (64u << 20) || off + 8 + off_t(len) > size) break;
+    buf.resize(len);
+    if (pread(s->fd, buf.data(), len, off + 8) != ssize_t(len)) break;
+    if (crc32(buf.data(), len) != crc) break;
+    Record r;
+    if (!decode_payload(buf.data(), len, &r)) break;
+    s->index[r.round] = std::move(r);
+    off += 8 + len;
+  }
+  if (off < size) {
+    // torn tail from a crash mid-append: drop it
+    if (ftruncate(s->fd, off) != 0) return false;
+  }
+  return true;
+}
+
+int fill(const Record& r, uint64_t* round, uint64_t* prev_round,
+         uint8_t* prev_sig, uint32_t* psl, uint8_t* sig, uint32_t* sl) {
+  if (r.prev_sig.size() > *psl || r.sig.size() > *sl) return -2;
+  *round = r.round;
+  *prev_round = r.prev_round;
+  memcpy(prev_sig, r.prev_sig.data(), r.prev_sig.size());
+  *psl = uint32_t(r.prev_sig.size());
+  memcpy(sig, r.sig.data(), r.sig.size());
+  *sl = uint32_t(r.sig.size());
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// path == NULL or "" => in-memory store.  fsync_puts != 0 => fsync after
+// every Put (durable against power loss, not just process crash).
+void* dtcs_open(const char* path, int fsync_puts) {
+  Store* s = new Store();
+  s->fsync_puts = fsync_puts != 0;
+  if (path != nullptr && path[0] != '\0') {
+    s->fd = ::open(path, O_RDWR | O_CREAT, 0600);
+    if (s->fd < 0 || !load(s)) {
+      if (s->fd >= 0) ::close(s->fd);
+      delete s;
+      return nullptr;
+    }
+  }
+  return s;
+}
+
+void dtcs_close(void* h) {
+  Store* s = static_cast<Store*>(h);
+  if (s == nullptr) return;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    if (s->fd >= 0) {
+      ::fsync(s->fd);
+      ::close(s->fd);
+      s->fd = -1;
+    }
+  }
+  delete s;
+}
+
+int dtcs_put(void* h, uint64_t round, uint64_t prev_round,
+             const uint8_t* prev_sig, uint32_t psl,
+             const uint8_t* sig, uint32_t sl) {
+  Store* s = static_cast<Store*>(h);
+  Record r;
+  r.round = round;
+  r.prev_round = prev_round;
+  r.prev_sig.assign(prev_sig, prev_sig + psl);
+  r.sig.assign(sig, sig + sl);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (s->fd >= 0) {
+    std::vector<uint8_t> payload = encode_payload(r);
+    std::vector<uint8_t> rec;
+    put_u32(rec, crc32(payload.data(), payload.size()));
+    put_u32(rec, uint32_t(payload.size()));
+    rec.insert(rec.end(), payload.begin(), payload.end());
+    off_t off = lseek(s->fd, 0, SEEK_END);
+    ssize_t n = pwrite(s->fd, rec.data(), rec.size(), off);
+    if (n != ssize_t(rec.size())) {
+      // keep the log consistent: drop the partial append
+      if (n > 0) (void)!ftruncate(s->fd, off);
+      return -1;
+    }
+    if (s->fsync_puts) ::fsync(s->fd);
+  }
+  s->index[round] = std::move(r);
+  return 0;
+}
+
+int64_t dtcs_count(void* h) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  return int64_t(s->index.size());
+}
+
+// All lookups return 0 on hit, -1 on miss, -2 if a buffer is too small.
+// psl/sl are in/out: capacity in, actual length out.
+
+int dtcs_get(void* h, uint64_t want, uint64_t* round, uint64_t* prev_round,
+             uint8_t* prev_sig, uint32_t* psl, uint8_t* sig, uint32_t* sl) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->index.find(want);
+  if (it == s->index.end()) return -1;
+  return fill(it->second, round, prev_round, prev_sig, psl, sig, sl);
+}
+
+int dtcs_first(void* h, uint64_t* round, uint64_t* prev_round,
+               uint8_t* prev_sig, uint32_t* psl,
+               uint8_t* sig, uint32_t* sl) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (s->index.empty()) return -1;
+  return fill(s->index.begin()->second, round, prev_round, prev_sig, psl,
+              sig, sl);
+}
+
+int dtcs_last(void* h, uint64_t* round, uint64_t* prev_round,
+              uint8_t* prev_sig, uint32_t* psl,
+              uint8_t* sig, uint32_t* sl) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (s->index.empty()) return -1;
+  return fill(s->index.rbegin()->second, round, prev_round, prev_sig, psl,
+              sig, sl);
+}
+
+// Smallest round >= want (cursor Seek; Next is seek(cur + 1)).
+int dtcs_seek(void* h, uint64_t want, uint64_t* round, uint64_t* prev_round,
+              uint8_t* prev_sig, uint32_t* psl,
+              uint8_t* sig, uint32_t* sl) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->index.lower_bound(want);
+  if (it == s->index.end()) return -1;
+  return fill(it->second, round, prev_round, prev_sig, psl, sig, sl);
+}
+
+}  // extern "C"

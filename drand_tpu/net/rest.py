@@ -8,6 +8,8 @@ Mirrors the reference's grpc-gateway with hex-JSON marshalling
   POST /api/private           ECIES private randomness
   GET  /api/info/group        group TOML
   GET  /api/info/distkey      collective key coefficients
+  GET  /metrics               Prometheus metrics (beyond the reference,
+                              which has no observability endpoints)
   GET  /                      home/status
 
 Divergence from the reference: the reference cmux-shares one port between
@@ -71,6 +73,16 @@ def build_rest_app(daemon) -> web.Application:
         if toml is None:
             raise web.HTTPNotFound(text="no group configured")
         return web.Response(text=toml, content_type="application/toml")
+
+    @routes.get("/metrics")
+    async def metrics_endpoint(request):
+        from drand_tpu.utils import metrics
+
+        return web.Response(
+            text=metrics.render(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
 
     @routes.get("/api/info/distkey")
     async def distkey(request):

@@ -49,7 +49,6 @@ from drand_tpu.ops.curve import (
     F2,
     point_add,
     point_double,
-    point_select,
 )
 
 #: |x| for BLS12-381 (the curve parameter is -|x|).
@@ -58,12 +57,62 @@ X_ABS = -ref.X_PARAM
 MILLER_BITS = np.array([int(c) for c in bin(X_ABS)[3:]], dtype=np.int32)
 
 
-def _sparse_line(a2, b2, c2):
-    """Assemble the Fp12 line element A + B w^2 + C w^3 (A,B,C in Fp2)."""
-    z = tower.fp2_zero(a2.shape[:-2])
-    c0 = jnp.stack([a2, b2, z], axis=-3)
-    c1 = jnp.stack([z, c2, z], axis=-3)
-    return jnp.stack([c0, c1], axis=-4)
+def _zero_runs(bits) -> list:
+    """[(run_of_zeros, then_one?), ...] decomposition of a bit pattern."""
+    out = []
+    i = 0
+    bits = list(bits)
+    while i < len(bits):
+        j = i
+        while j < len(bits) and bits[j] == 0:
+            j += 1
+        has_one = j < len(bits)
+        out.append((j - i, has_one))
+        i = j + 1
+    return out
+
+
+def _segment_scan(state, bits, sqr_step, mul_step):
+    """Run square-and-multiply over a STATIC bit pattern as a scan over
+    its zero-run segments.
+
+    The exponents here (|x| and neighbours — popcount 6) are almost all
+    zeros, so a naive scan-over-bits pays for the multiply branch on
+    every zero bit.  Decomposing into (zero-run, one?) segments instead:
+
+      for (run, has_one) in segments:   # lax.scan — ONE traced body
+          repeat run times: state = sqr_step(state)   # lax.while_loop
+          if has_one:       state = mul_step(state)   # select
+
+    keeps compile cost at scan-over-bits level (each heavy body traces
+    exactly once) while the executed op count drops to run-length sqrs
+    plus popcount multiplies — the zero-bit multiply work runs once per
+    *segment* (≈7) instead of once per *bit* (63/64).
+    """
+    segs = _zero_runs(bits)
+    runs = jnp.asarray([r for r, _ in segs], dtype=jnp.int32)
+    ones = jnp.asarray(
+        [1 if o else 0 for _, o in segs], dtype=jnp.int32
+    )
+
+    def seg_body(st, seg):
+        run, has_one = seg
+
+        def while_body(carry):
+            i, s = carry
+            return (i + 1, sqr_step(s))
+
+        _, st = lax.while_loop(
+            lambda c: c[0] < run, while_body, (jnp.int32(0), st)
+        )
+        st_mul = mul_step(st)
+        st = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(has_one != 0, a, b), st_mul, st
+        )
+        return st, None
+
+    state, _ = lax.scan(seg_body, state, (runs, ones))
+    return state
 
 
 def _line_dbl(t, px, py):
@@ -134,6 +183,10 @@ def miller_loop(p_affine, q_affine):
     p_affine: (..., 2, NLIMB)      affine G1 point (x, y), Montgomery limbs
     q_affine: (..., 2, 2, NLIMB)   affine twist G2 point (x, y) in Fp2
     returns:  (..., 2, 3, 2, NLIMB) Fp12 Miller value
+
+    Static-segment structure (see `_zero_runs`): every iteration does the
+    doubling step (fp12 square + sparse line multiply); add steps exist
+    only at the 5 one-bits of |x|.
     """
     px = p_affine[..., 0, :]
     py = p_affine[..., 1, :]
@@ -142,44 +195,44 @@ def miller_loop(p_affine, q_affine):
     one2 = tower.fp2_one(xq.shape[:-2])
     q_proj = jnp.stack([xq, yq, one2], axis=-3)
 
-    f0 = tower.fp12_one(px.shape[:-1])
-    carry0 = (f0, q_proj)
-
-    def step(carry, bit):
-        f, t = carry
+    def dbl_step(state):
+        f, t = state
         a2, b2, c2 = _line_dbl(t, px, py)
         t = point_double(t, F2)
-        f = tower.fp12_mul(tower.fp12_sqr(f), _sparse_line(a2, b2, c2))
-        # conditional add step (bit pattern is a trace-time constant array)
-        a2, b2, c2 = _line_add(t, xq, yq, px, py)
-        t_added = point_add(t, q_proj, F2)
-        f_added = tower.fp12_mul(f, _sparse_line(a2, b2, c2))
-        sel = bit != 0
-        f = jnp.where(
-            sel.reshape(sel.shape + (1,) * 4), f_added, f
-        )
-        t = point_select(sel, t_added, t, F2)
-        return (f, t), None
+        f = tower.fp12_mul_by_line(tower.fp12_sqr(f), a2, b2, c2)
+        return f, t
 
-    (f, _), _ = lax.scan(step, carry0, jnp.asarray(MILLER_BITS))
+    def add_step(state):
+        f, t = state
+        a2, b2, c2 = _line_add(t, xq, yq, px, py)
+        t = point_add(t, q_proj, F2)
+        f = tower.fp12_mul_by_line(f, a2, b2, c2)
+        return f, t
+
+    state = (tower.fp12_one(px.shape[:-1]), q_proj)
+    state = _segment_scan(
+        state, MILLER_BITS,
+        sqr_step=dbl_step,
+        mul_step=lambda s: add_step(dbl_step(s)),
+    )
+    f, _ = state
     return tower.fp12_conj(f)  # x < 0
 
 
 def _pow_cyc(a, e: int):
-    """a^e on the unitary (cyclotomic) subgroup, static positive exponent."""
+    """a^e on the unitary (cyclotomic) subgroup, static positive exponent.
+
+    Granger–Scott cyclotomic squarings over the zero runs; generic
+    multiplies only at the one-bits (see `_segment_scan`)."""
     assert e > 0
-    bits = np.array([int(c) for c in bin(e)[2:]], dtype=np.int32)
-
-    def step(acc, bit):
-        acc = tower.fp12_sqr(acc)
-        acc = jnp.where(
-            (bit != 0).reshape((1,) * acc.ndim), tower.fp12_mul(acc, a), acc
-        )
-        return acc, None
-
-    # start from a (leading bit) to avoid needing a one() of matching shape
-    out, _ = lax.scan(step, a, jnp.asarray(bits[1:]))
-    return out
+    bits = [int(c) for c in bin(e)[3:]]  # after the leading one
+    return _segment_scan(
+        a, bits,
+        sqr_step=tower.fp12_cyclotomic_sqr,
+        mul_step=lambda s: tower.fp12_mul(
+            tower.fp12_cyclotomic_sqr(s), a
+        ),
+    )
 
 
 @jax.jit

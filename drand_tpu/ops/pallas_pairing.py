@@ -49,8 +49,6 @@ NP_L = [int(v) for v in fpx.NP_LIMBS]
 
 X_ABS = -ref.X_PARAM
 MILLER_BITS = np.array([int(c) for c in bin(X_ABS)[3:]], dtype=np.int32)
-XBITS = np.array([int(c) for c in bin(X_ABS)[2:]], dtype=np.int32)
-X1BITS = np.array([int(c) for c in bin(X_ABS + 1)[2:]], dtype=np.int32)
 PM2BITS = np.array([int(c) for c in bin(ref.P - 2)[2:]], dtype=np.int32)
 
 def _pack_words(bits: np.ndarray):
@@ -69,10 +67,9 @@ def _pack_words(bits: np.ndarray):
     return words
 
 
+# only p-2 (Fermat inversion) still runs bit-by-bit; the Miller loop and
+# the final-exp pows consume their patterns as static segment structure
 _BITS_PARTS = {
-    "MILLER": MILLER_BITS,
-    "X": XBITS,
-    "X1": X1BITS,
     "PM2": PM2BITS,
 }
 BIT_LEN = {name: len(arr) for name, arr in _BITS_PARTS.items()}
@@ -407,6 +404,71 @@ def fp12_mul(a, b):
     )
 
 
+def _fp6_mul_sparse2(x, a2, b2):
+    """fp6 * (A + B v): 5 fp2 muls (third coefficient absent)."""
+    x0, x1, x2 = x
+    v0 = fp2_mul(x0, a2)
+    v1 = fp2_mul(x1, b2)
+    t01 = fp2_mul(fp2_add(x0, x1), fp2_add(a2, b2))
+    t02 = fp2_mul(fp2_add(x0, x2), a2)
+    t12 = fp2_mul(fp2_add(x1, x2), b2)
+    c0 = fp2_add(v0, fp2_mul_xi(fp2_sub(t12, v1)))
+    c1 = fp2_sub(t01, fp2_add(v0, v1))
+    c2 = fp2_add(fp2_sub(t02, v0), v1)
+    return (c0, c1, c2)
+
+
+def fp12_mul_by_line(f, a2, b2, c2):
+    """Sparse multiply by a line A + B v + (C v) w — 13 fp2 muls
+    (mirrors ops/tower.py fp12_mul_by_line)."""
+    f0, f1 = f
+    t0 = _fp6_mul_sparse2(f0, a2, b2)
+    # f1 * (C v) = xi (y2 C) + (y0 C) v + (y1 C) v^2
+    y0, y1, y2 = f1
+    t1 = (fp2_mul_xi(fp2_mul(y2, c2)), fp2_mul(y0, c2),
+          fp2_mul(y1, c2))
+    t2 = _fp6_mul_sparse2(
+        fp6_add(f0, f1), a2, fp2_add(b2, c2)
+    )
+    return (
+        fp6_add(t0, fp6_mul_by_v(t1)),
+        fp6_sub(t2, fp6_add(t0, t1)),
+    )
+
+
+def fp12_cyclotomic_sqr(a):
+    """Granger–Scott cyclotomic squaring: 9 fp2 sqrs (18 base muls)
+    versus 36 for fp12_sqr.  Valid only on the unitary subgroup
+    (mirrors ops/tower.py fp12_cyclotomic_sqr; eprint 2009/565 §3.2)."""
+    a0, a1 = a
+    z0, z2, z4 = a0
+    z1, z3, z5 = a1
+
+    def pair(x, y):
+        sx = fp2_sqr(x)
+        sy = fp2_sqr(y)
+        sxy = fp2_sqr(fp2_add(x, y))
+        return (
+            fp2_add(sx, fp2_mul_xi(sy)),
+            fp2_sub(sxy, fp2_add(sx, sy)),
+        )
+
+    ta, ca = pair(z0, z3)
+    tb, cb = pair(z1, z4)
+    tc, cc = pair(z2, z5)
+
+    def lo(t, z):
+        return fp2_sub(fp2_muls(t, 3), fp2_muls(z, 2))
+
+    def hi(c, z):
+        return fp2_add(fp2_muls(c, 3), fp2_muls(z, 2))
+
+    return (
+        (lo(ta, z0), lo(tb, z2), lo(tc, z4)),
+        (hi(fp2_mul_xi(cc), z1), hi(ca, z3), hi(cb, z5)),
+    )
+
+
 def fp12_sqr(a):
     a0, a1 = a
     t = fp6_mul(a0, a1)
@@ -482,21 +544,58 @@ def _stack_to_fp12(s):
     return (out[0], out[1])
 
 
-def _pow_loop(a, pattern):
-    """a^e on the unitary subgroup; `pattern` names an SMEM bit range."""
-    stack0 = _fp12_to_stack(a)
+from drand_tpu.ops.pairing import _zero_runs  # trace-time helper
 
-    def body(i, s):
-        cur = _stack_to_fp12(s)
-        sq = fp12_sqr(cur)
-        mu = fp12_mul(sq, a)
-        return jnp.where(
-            _bit(pattern, i) != 0,
-            _fp12_to_stack(mu), _fp12_to_stack(sq),
-        )
 
-    out = lax.fori_loop(1, BIT_LEN[pattern], body, stack0)
-    return _stack_to_fp12(out)
+def _seg_lookup(segs, k):
+    """(run, has_one) of segment k, via arithmetic select chains over
+    immediates (no memory access — lowers inside Mosaic loop bodies)."""
+    run = jnp.int32(0)
+    one = jnp.int32(0)
+    for idx, (r, o) in enumerate(segs):
+        run = jnp.where(k == idx, jnp.int32(r), run)
+        one = jnp.where(k == idx, jnp.int32(1 if o else 0), one)
+    return run, one
+
+
+def _segment_scan(state, bits, sqr_step, mul_step, to_stack, from_stack):
+    """Square-and-multiply over a static, mostly-zero bit pattern with
+    every heavy body traced exactly once (mirrors ops/pairing.py):
+    an outer fori over segments, an inner dynamic-trip while of square
+    steps, and a selected multiply at segment ends.  Keeps Mosaic
+    compile cost at one-body level while executing only run-length
+    squares plus popcount multiplies."""
+    segs = _zero_runs(bits)
+
+    def seg_body(k, st):
+        run, has_one = _seg_lookup(segs, k)
+
+        def wcond(c):
+            return c[0] < run
+
+        def wbody(c):
+            i, s = c
+            return (i + 1, to_stack(sqr_step(from_stack(s))))
+
+        _, st = lax.while_loop(wcond, wbody, (jnp.int32(0), st))
+        st_mul = to_stack(mul_step(from_stack(st)))
+        return jnp.where(has_one != 0, st_mul, st)
+
+    out = lax.fori_loop(0, len(segs), seg_body, to_stack(state))
+    return from_stack(out)
+
+
+def _pow_cyc(a, e: int):
+    """a^e on the unitary subgroup, static positive exponent."""
+    assert e > 0
+    bits = [int(c) for c in bin(e)[3:]]  # after the leading one
+    return _segment_scan(
+        a, bits,
+        sqr_step=fp12_cyclotomic_sqr,
+        mul_step=lambda s: fp12_mul(fp12_cyclotomic_sqr(s), a),
+        to_stack=_fp12_to_stack,
+        from_stack=_stack_to_fp12,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -581,11 +680,6 @@ def _line_add(t, xq, yq, px, py):
     return a2, b2, c2
 
 
-def _sparse12(a2, b2, c2, b):
-    z2 = fp2_zero(b)
-    return ((a2, b2, z2), (z2, c2, z2))
-
-
 # ---------------------------------------------------------------------------
 # Canonicalization for the is-one comparison.
 # ---------------------------------------------------------------------------
@@ -624,42 +718,53 @@ def _from_mont(a):
 # ---------------------------------------------------------------------------
 
 
+def _t_to_stack(t):
+    return jnp.stack(
+        [t[0][0], t[0][1], t[1][0], t[1][1], t[2][0], t[2][1]], axis=0
+    )
+
+
+def _stack_to_t(ts):
+    return ((ts[0], ts[1]), (ts[2], ts[3]), (ts[4], ts[5]))
+
+
 def _miller(px, py, xq, yq, b):
-    """One batched Miller loop (fori over the static bit pattern)."""
-    f_stack0 = _fp12_to_stack(fp12_one(b))
-    t_stack0 = jnp.stack(
-        [xq[0], xq[1], yq[0], yq[1]]
-        + [fp2_one(b)[0], fp2_one(b)[1]],
-        axis=0,
-    )
+    """One batched Miller loop over the segment structure of |x|: a
+    doubling-only body for the zero runs, doubling+add at the 5
+    one-bits (see `_segment_scan` — each body traces once)."""
 
-    def mil_body(i, state):
-        fs, ts = state
-        fcur = _stack_to_fp12(fs)
-        tcur = ((ts[0], ts[1]), (ts[2], ts[3]), (ts[4], ts[5]))
-        a2, bb2, c2 = _line_dbl(tcur, px, py)
-        tnew = point_double2(tcur)
-        fnew = fp12_mul(fp12_sqr(fcur), _sparse12(a2, bb2, c2, b))
-        a2, bb2, c2 = _line_add(tnew, xq, yq, px, py)
-        tadd = point_add2(tnew, (xq, yq, fp2_one(b)))
-        fadd = fp12_mul(fnew, _sparse12(a2, bb2, c2, b))
-        sel = _bit("MILLER", i) != 0
-        fs_out = jnp.where(
-            sel, _fp12_to_stack(fadd), _fp12_to_stack(fnew)
-        )
-        ts_out = jnp.where(
-            sel,
-            jnp.stack([tadd[0][0], tadd[0][1], tadd[1][0], tadd[1][1],
-                       tadd[2][0], tadd[2][1]], axis=0),
-            jnp.stack([tnew[0][0], tnew[0][1], tnew[1][0], tnew[1][1],
-                       tnew[2][0], tnew[2][1]], axis=0),
-        )
-        return (fs_out, ts_out)
+    def dbl_step(state):
+        f, t = state
+        a2, bb2, c2 = _line_dbl(t, px, py)
+        t = point_double2(t)
+        f = fp12_mul_by_line(fp12_sqr(f), a2, bb2, c2)
+        return f, t
 
-    fs, _ = lax.fori_loop(
-        0, BIT_LEN["MILLER"], mil_body, (f_stack0, t_stack0)
+    def add_step(state):
+        f, t = state
+        a2, bb2, c2 = _line_add(t, xq, yq, px, py)
+        t = point_add2(t, (xq, yq, fp2_one(b)))
+        f = fp12_mul_by_line(f, a2, bb2, c2)
+        return f, t
+
+    def to_stack(state):
+        f, t = state
+        return jnp.concatenate(
+            [_fp12_to_stack(f), _t_to_stack(t)], axis=0
+        )
+
+    def from_stack(s):
+        return (_stack_to_fp12(s[:12]), _stack_to_t(s[12:18]))
+
+    state = (fp12_one(b), (xq, yq, fp2_one(b)))
+    state = _segment_scan(
+        state, MILLER_BITS,
+        sqr_step=dbl_step,
+        mul_step=lambda s: add_step(dbl_step(s)),
+        to_stack=to_stack,
+        from_stack=from_stack,
     )
-    return fp12_conj(_stack_to_fp12(fs))  # x < 0
+    return fp12_conj(state[0])  # x < 0
 
 
 def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
@@ -695,14 +800,14 @@ def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
     # final exponentiation (cubed; see ops/pairing.py)
     t0 = fp12_mul(fp12_conj(g), fp12_inv(g))
     t0 = fp12_mul(fp12_frob2(t0), t0)
-    a = fp12_conj(_pow_loop(t0, "X1"))
-    a = fp12_conj(_pow_loop(a, "X1"))
-    bb = fp12_mul(fp12_conj(_pow_loop(a, "X")), fp12_frob1(a))
+    a = fp12_conj(_pow_cyc(t0, X_ABS + 1))
+    a = fp12_conj(_pow_cyc(a, X_ABS + 1))
+    bb = fp12_mul(fp12_conj(_pow_cyc(a, X_ABS)), fp12_frob1(a))
     c = fp12_mul(
-        _pow_loop(_pow_loop(bb, "X"), "X"),
+        _pow_cyc(_pow_cyc(bb, X_ABS), X_ABS),
         fp12_mul(fp12_frob2(bb), fp12_conj(bb)),
     )
-    t3 = fp12_mul(fp12_sqr(t0), t0)
+    t3 = fp12_mul(fp12_cyclotomic_sqr(t0), t0)
     e = fp12_mul(c, t3)
 
     # canonical is-one comparison

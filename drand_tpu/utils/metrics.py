@@ -1,0 +1,194 @@
+"""Process-wide metrics registry with Prometheus text exposition.
+
+The reference has no metrics at all — observability is logs only (SURVEY
+§5; /root/reference/Makefile runs plain `go test`, no pprof/metrics
+endpoints anywhere).  The TPU build does better: counters/gauges/
+histograms for the protocol plane (rounds, partials, sync batches) and
+per-kernel device timings for the crypto plane, exposed at the REST
+gateway's ``/metrics`` in Prometheus text format.
+
+Deliberately dependency-free (no prometheus_client): a few dozen lines
+cover everything the daemon needs, and the registry stays importable from
+the pure-protocol path without pulling in jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self):
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v += amount
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Gauge:
+    def __init__(self):
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = v
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Histogram:
+    def __init__(self, buckets: Tuple[float, ...] = _BUCKETS):
+        self._buckets = buckets
+        self._counts = [0] * (len(buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._n += 1
+            for i, b in enumerate(self._buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def time(self) -> "_Timer":
+        return _Timer(self)
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+
+class _Timer:
+    def __init__(self, h: Histogram):
+        self._h = h
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._h.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[
+            Tuple[str, Tuple[Tuple[str, str], ...]], object
+        ] = {}
+        self._help: Dict[str, Tuple[str, str]] = {}  # name -> (type, help)
+
+    def _get(self, kind, name: str, help: str, labels: Optional[dict]):
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = kind()
+                self._metrics[key] = m
+                self._help.setdefault(
+                    name,
+                    (
+                        {
+                            Counter: "counter",
+                            Gauge: "gauge",
+                            Histogram: "histogram",
+                        }[kind],
+                        help,
+                    ),
+                )
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Optional[dict] = None) -> Counter:
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[dict] = None) -> Gauge:
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Optional[dict] = None) -> Histogram:
+        return self._get(Histogram, name, help, labels)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+            helps = dict(self._help)
+        lines: List[str] = []
+        seen_header = set()
+        for (name, labels), m in items:
+            if name not in seen_header:
+                typ, help = helps.get(name, ("untyped", ""))
+                if help:
+                    lines.append(f"# HELP {name} {help}")
+                lines.append(f"# TYPE {name} {typ}")
+                seen_header.add(name)
+            lab = _fmt_labels(labels)
+            if isinstance(m, Counter):
+                lines.append(f"{name}{lab} {m.value}")
+            elif isinstance(m, Gauge):
+                lines.append(f"{name}{lab} {m.value}")
+            elif isinstance(m, Histogram):
+                acc = 0
+                for b, c in zip(m._buckets, m._counts):
+                    acc += c
+                    blab = dict(labels)
+                    blab["le"] = repr(b)
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_labels(tuple(sorted(blab.items())))} {acc}"
+                    )
+                blab = dict(labels)
+                blab["le"] = "+Inf"
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_fmt_labels(tuple(sorted(blab.items())))} {m.count}"
+                )
+                lines.append(f"{name}_sum{lab} {m.sum}")
+                lines.append(f"{name}_count{lab} {m.count}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self._help.clear()
+
+
+#: the default process-wide registry
+REGISTRY = Registry()
+
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+render = REGISTRY.render

@@ -1,0 +1,56 @@
+"""JAX profiler integration for the device crypto path.
+
+SURVEY §5: the reference has no tracing/profiling at all; here any
+daemon or benchmark run can capture a TensorBoard-compatible device
+trace of the pairing/MSM kernels.
+
+Enable with the environment variable
+``DRAND_TPU_PROFILE_DIR=/path/to/tracedir`` (checked once at first use)
+or explicitly via :func:`profile_span`:
+
+    with profile_span("chain-verify"):
+        scheme.verify_chain_batch(...)
+
+Spans nest; when no trace dir is configured they are zero-cost no-ops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Iterator, Optional
+
+_lock = threading.Lock()
+_trace_dir: Optional[str] = None
+_active = 0
+
+
+def trace_dir() -> Optional[str]:
+    return os.environ.get("DRAND_TPU_PROFILE_DIR") or None
+
+
+@contextlib.contextmanager
+def profile_span(name: str) -> Iterator[None]:
+    """Wrap a block in a named JAX profiler trace (no-op when disabled)."""
+    global _active
+    tdir = trace_dir()
+    if tdir is None:
+        yield
+        return
+    import jax
+
+    with _lock:
+        start = _active == 0
+        _active += 1
+    try:
+        if start:
+            jax.profiler.start_trace(tdir)
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        with _lock:
+            _active -= 1
+            stop = _active == 0
+        if stop:
+            jax.profiler.stop_trace()

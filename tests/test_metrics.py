@@ -1,0 +1,53 @@
+"""Metrics registry: counters/gauges/histograms + Prometheus rendering.
+
+The reference ships no metrics (SURVEY §5); this subsystem is TPU-build
+added value, so it gets its own unit tier."""
+
+from drand_tpu.utils.metrics import Registry
+
+
+def test_counter_gauge_histogram_render():
+    reg = Registry()
+    c = reg.counter("rounds_total", "rounds")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+
+    g = reg.gauge("head_round", "chain head")
+    g.set(41)
+    g.set(42)
+    assert g.value == 42
+
+    h = reg.histogram("round_seconds", "latency")
+    for v in (0.0007, 0.003, 0.003, 70.0):
+        h.observe(v)
+    assert h.count == 4
+    assert abs(h.sum - 70.0067) < 1e-9
+
+    text = reg.render()
+    assert "# TYPE rounds_total counter" in text
+    assert "rounds_total 3" in text
+    assert "# HELP head_round chain head" in text
+    assert "head_round 42" in text
+    assert 'round_seconds_bucket{le="0.001"} 1' in text
+    assert 'round_seconds_bucket{le="0.005"} 3' in text
+    assert 'round_seconds_bucket{le="+Inf"} 4' in text
+    assert "round_seconds_count 4" in text
+
+
+def test_labels_and_timer():
+    reg = Registry()
+    a = reg.counter("kernel_calls", "calls", labels={"op": "pairing"})
+    b = reg.counter("kernel_calls", "calls", labels={"op": "msm"})
+    assert a is not b
+    # same (name, labels) returns the same instance
+    assert reg.counter("kernel_calls", labels={"op": "msm"}) is b
+    a.inc()
+    text = reg.render()
+    assert 'kernel_calls{op="pairing"} 1' in text
+    assert 'kernel_calls{op="msm"} 0' in text
+
+    h = reg.histogram("t", "timer")
+    with h.time():
+        pass
+    assert h.count == 1

@@ -144,6 +144,104 @@ def test_fp2_mul_and_point_double_vs_oracle():
     assert aff == want
 
 
+def _pack12(v12):
+    """Oracle fp12 -> (12*NL, B) stacked rows (broadcast over lanes)."""
+    rows = []
+    for j in range(2):
+        for i in range(3):
+            for c in range(2):
+                rows.append(np.stack([col(v12[j][i][c])] * B, axis=1))
+    return jnp.asarray(np.concatenate(rows, axis=0))
+
+
+def _unpack12(arr, lane=0):
+    rinv = pow(fp.R_MONT, -1, ref.P)
+    vals = [
+        decode(arr[k * pp.NL : (k + 1) * pp.NL, lane]) * rinv % ref.P
+        for k in range(12)
+    ]
+    it = iter(vals)
+    return tuple(
+        tuple((next(it), next(it)) for _ in range(3)) for _ in range(2)
+    )
+
+
+def _rand_fp12():
+    return tuple(
+        tuple(
+            tuple(rng.randrange(ref.P) for _ in range(2))
+            for _ in range(3)
+        )
+        for _ in range(2)
+    )
+
+
+def _unitary(f12):
+    u = ref.fp12_mul(ref.fp12_conj(f12), ref.fp12_inv(f12))
+    return ref.fp12_mul(ref.fp12_frob2(u), u)
+
+
+def test_cyclotomic_sqr_and_pow_vs_oracle():
+    u = _unitary(_rand_fp12())
+
+    def kcyc(s):
+        return pp._fp12_to_stack(
+            pp.fp12_cyclotomic_sqr(pp._stack_to_fp12(
+                [s[k * pp.NL : (k + 1) * pp.NL] for k in range(12)]
+            ))
+        ).reshape(12 * pp.NL, B)
+
+    out = np.asarray(run_rows(kcyc, 12 * pp.NL, _pack12(u)))
+    assert _unpack12(out) == ref.fp12_mul(u, u)
+
+    # small segment-structured pow on the unitary subgroup (e = 0b100100
+    # exercises runs, one-bits, and a trailing zero run)
+    e = 0b100100
+
+    def kpow(s):
+        a = pp._stack_to_fp12(
+            [s[k * pp.NL : (k + 1) * pp.NL] for k in range(12)]
+        )
+        return pp._fp12_to_stack(pp._pow_cyc(a, e)).reshape(
+            12 * pp.NL, B
+        )
+
+    out = np.asarray(run_rows(kpow, 12 * pp.NL, _pack12(u)))
+    assert _unpack12(out) == ref.fp12_pow(u, e)
+
+
+def test_line_mul_vs_oracle():
+    g = _rand_fp12()
+    A = (rng.randrange(ref.P), rng.randrange(ref.P))
+    Bc = (rng.randrange(ref.P), rng.randrange(ref.P))
+    C = (rng.randrange(ref.P), rng.randrange(ref.P))
+
+    def pack2(v):
+        return jnp.asarray(np.concatenate(
+            [np.stack([col(v[0])] * B, axis=1),
+             np.stack([col(v[1])] * B, axis=1)], axis=0
+        ))
+
+    def kline(s, la, lb, lc):
+        f = pp._stack_to_fp12(
+            [s[k * pp.NL : (k + 1) * pp.NL] for k in range(12)]
+        )
+        out = pp.fp12_mul_by_line(
+            f,
+            (la[: pp.NL], la[pp.NL :]),
+            (lb[: pp.NL], lb[pp.NL :]),
+            (lc[: pp.NL], lc[pp.NL :]),
+        )
+        return pp._fp12_to_stack(out).reshape(12 * pp.NL, B)
+
+    out = np.asarray(run_rows(
+        kline, 12 * pp.NL, _pack12(g), pack2(A), pack2(Bc), pack2(C)
+    ))
+    zero2 = (0, 0)
+    line = ((A, Bc, zero2), (zero2, C, zero2))
+    assert _unpack12(out) == ref.fp12_mul(g, line)
+
+
 def test_bit_patterns_match():
     # the packed-word arithmetic bit reader must reproduce the patterns
     for name, bits in pp._BITS_PARTS.items():

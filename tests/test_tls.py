@@ -1,0 +1,57 @@
+"""TLS gateway smoke tests (reference net/gateway_test.go:85 and the
+self-signed-cert daemon tier, core/drand_test.go:577-590)."""
+
+import asyncio
+
+import pytest
+
+from drand_tpu.key import Identity
+from drand_tpu.net.tls import CertManager, generate_self_signed
+from drand_tpu.net.transport import GrpcClient, build_public_server
+
+from test_core import free_ports
+
+
+class _FakeDaemon:
+    def home_status(self) -> str:
+        return "tls-smoke"
+
+    def fetch_public_rand(self, round):
+        raise KeyError("no chain")
+
+    def group_toml(self):
+        return None
+
+
+@pytest.mark.asyncio
+async def test_tls_server_roundtrip_and_untrusted_rejected():
+    (port,) = free_ports(1)
+    addr = f"127.0.0.1:{port}"
+    cert_pem, key_pem = generate_self_signed("127.0.0.1")
+
+    server = build_public_server(_FakeDaemon(), addr, tls=(cert_pem, key_pem))
+    await server.start()
+    try:
+        peer = Identity(address=addr, key=None, tls=True)
+
+        certs = CertManager()
+        certs.add(cert_pem)
+        client = GrpcClient(certs)
+        status = await client.home(peer)
+        assert status == "tls-smoke"
+        await client.close()
+
+        # a client that does not trust the self-signed cert must fail
+        stranger = GrpcClient(CertManager())
+        with pytest.raises(Exception):
+            await asyncio.wait_for(stranger.home(peer), 10)
+        await stranger.close()
+
+        # plaintext to a TLS port must fail too
+        plain = GrpcClient(CertManager())
+        plain_peer = Identity(address=addr, key=None, tls=False)
+        with pytest.raises(Exception):
+            await asyncio.wait_for(plain.home(plain_peer), 10)
+        await plain.close()
+    finally:
+        await server.stop(1)

@@ -1,0 +1,132 @@
+"""A fake drand-tpu node serving a canned, deterministic chain.
+
+Analog of the reference's client-interop fixture
+(/root/reference/test/api/serve.go): stands up the REAL public gRPC
+service and REST gateway, but backed by a deterministic in-memory chain
+generated from the interop vectors (tools/vectors.py) instead of a live
+network — so client implementations can be tested against stable data.
+
+Run:  python tools/fake_server.py [--port 8080] [--rest 8081] [--rounds 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from drand_tpu.beacon.chain import Beacon, beacon_message  # noqa: E402
+from drand_tpu.beacon.store import BeaconStore  # noqa: E402
+from drand_tpu.crypto import refimpl as ref  # noqa: E402
+from drand_tpu.crypto import tbls  # noqa: E402
+from drand_tpu.crypto.poly import PriPoly, PubPoly  # noqa: E402
+from drand_tpu.key import Group, Pair  # noqa: E402
+from drand_tpu.utils import toml_dumps  # noqa: E402
+from tools.vectors import _DetRng  # noqa: E402
+
+
+class FakeDaemon:
+    """Duck-typed core.Drand surface for the public server + REST."""
+
+    def __init__(self, rounds: int):
+        rng = _DetRng(b"drand-tpu-interop-v1")
+        n, t = 4, 3
+        pairs = [
+            Pair.generate(f"127.0.0.1:{8000 + i}", rng=rng)
+            for i in range(n)
+        ]
+        self.group = Group(
+            nodes=[p.public for p in pairs], threshold=t, period=30.0,
+            genesis_time=1_700_000_000,
+        )
+        poly = PriPoly.random(t, rng=rng)
+        self.shares = [poly.eval(i) for i in range(n)]
+        self.pub = PubPoly(poly.commit().commits)
+        self.dist_key = self.pub.commits[0]
+        self.scheme = tbls.RefScheme()
+        self.store = BeaconStore()
+
+        seed = self.group.get_genesis_seed()
+        self.store.put(Beacon(0, 0, b"", seed))
+        prev_sig, prev_round = seed, 0
+        for r in range(1, rounds + 1):
+            msg = beacon_message(prev_sig, prev_round, r)
+            partials = [
+                self.scheme.partial_sign(s, msg) for s in self.shares[:t]
+            ]
+            sig = self.scheme.recover(self.pub, msg, partials, t, n)
+            self.store.put(Beacon(r, prev_round, prev_sig, sig))
+            prev_sig, prev_round = sig, r
+
+    # -- public surface ---------------------------------------------------
+
+    def fetch_public_rand(self, round: int) -> Beacon:
+        b = self.store.last() if round == 0 else self.store.get(round)
+        if b is None:
+            raise KeyError(f"no beacon for round {round}")
+        return b
+
+    def serve_private_rand(self, blob: bytes) -> bytes:
+        raise ValueError("fake server holds no private key material")
+
+    def subscribe_beacons(self):
+        return asyncio.Queue()  # canned chain: stream never fires
+
+    def unsubscribe_beacons(self, q) -> None:
+        pass
+
+    def group_toml(self) -> str:
+        return toml_dumps(self.group.to_dict())
+
+    def home_status(self) -> str:
+        return "fake drand-tpu node serving canned interop data"
+
+    def collective_key_hex(self):
+        return [ref.g1_to_bytes(c).hex() for c in self.pub.commits]
+
+    def serve_sync_chain(self, from_round: int):
+        return self.store.range_from(from_round)
+
+    async def process_beacon_packet(self, packet) -> None:
+        raise ValueError("fake server accepts no protocol traffic")
+
+
+async def amain(port: int, rest_port: int, rounds: int) -> None:
+    from drand_tpu.net.rest import build_rest_app, start_rest
+    from drand_tpu.net.transport import build_public_server
+
+    daemon = FakeDaemon(rounds)
+    server = build_public_server(daemon, f"127.0.0.1:{port}")
+    await server.start()
+    runner = await start_rest(
+        build_rest_app(daemon), rest_port, host="127.0.0.1"
+    )
+    print(f"fake drand-tpu node: gRPC 127.0.0.1:{port}, "
+          f"REST http://127.0.0.1:{rest_port}/api/public "
+          f"({rounds} canned rounds)")
+    print(f"collective key: {daemon.collective_key_hex()[0]}")
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.stop(1)
+        await runner.cleanup()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--rest", type=int, default=8081)
+    ap.add_argument("--rounds", type=int, default=5)
+    args = ap.parse_args()
+    try:
+        asyncio.run(amain(args.port, args.rest, args.rounds))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,144 @@
+"""Emit deterministic interop test vectors as JSON.
+
+Analog of the reference's cross-repo vector emitter
+(/root/reference/test/test-integration/json_output.go, used for drandjs
+interop): deterministic keypairs, a group file, the chained beacon
+message derivation, partial signatures, the recovered group signature,
+and the final randomness — everything another implementation needs to
+check byte-for-byte compatibility with this framework.
+
+Run:  python tools/vectors.py [--out vectors.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from drand_tpu.beacon.chain import beacon_message, randomness  # noqa: E402
+from drand_tpu.crypto import refimpl as ref  # noqa: E402
+from drand_tpu.crypto import tbls  # noqa: E402
+from drand_tpu.crypto.poly import PriPoly, lagrange_basis_at_zero  # noqa: E402
+from drand_tpu.key import Group, Pair  # noqa: E402
+from drand_tpu.utils import toml_dumps  # noqa: E402
+
+
+class _DetRng:
+    """Deterministic byte stream: SHA-256 counter mode over a seed."""
+
+    def __init__(self, seed: bytes):
+        self.seed = seed
+        self.ctr = 0
+
+    def __call__(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            out += hashlib.sha256(
+                self.seed + self.ctr.to_bytes(8, "big")
+            ).digest()
+            self.ctr += 1
+        return out[:n]
+
+
+def build_vectors() -> dict:
+    rng = _DetRng(b"drand-tpu-interop-v1")
+    n, t = 4, 3
+
+    pairs = [
+        Pair.generate(f"127.0.0.1:{8000 + i}", rng=rng) for i in range(n)
+    ]
+    group = Group(
+        nodes=[p.public for p in pairs],
+        threshold=t,
+        period=30.0,
+        genesis_time=1_700_000_000,
+    )
+    poly = PriPoly.random(t, rng=rng)
+    shares = [poly.eval(i) for i in range(n)]
+    commits = poly.commit().commits
+    dist_key = commits[0]
+
+    scheme = tbls.RefScheme()
+
+    # round 1 signs over the genesis seed chain link
+    genesis_seed = group.get_genesis_seed()
+    msg1 = beacon_message(genesis_seed, 0, 1)
+    partials = [
+        scheme.partial_sign(s, msg1) for s in shares
+    ]
+    from drand_tpu.crypto.poly import PubPoly
+
+    pub = PubPoly(commits)
+    sig1 = scheme.recover(pub, msg1, partials[:t], t, n)
+    scheme.verify_recovered(dist_key, msg1, sig1)
+
+    # round 2 chains over round 1
+    msg2 = beacon_message(sig1, 1, 2)
+    partials2 = [scheme.partial_sign(s, msg2) for s in shares]
+    sig2 = scheme.recover(pub, msg2, partials2[1 : 1 + t], t, n)
+    scheme.verify_recovered(dist_key, msg2, sig2)
+
+    lam = lagrange_basis_at_zero(list(range(t)))
+
+    return {
+        "suite": "BLS12-381, keys in G1 (48B), sigs in G2 (96B), "
+                 "tbls partial = 2B BE index || 96B sig",
+        "hash_to_curve": "SVDW map, SHA-256 expand (refimpl)",
+        "keypairs": [
+            {
+                "address": p.public.address,
+                "private": format(p.private, "064x"),
+                "public": p.public.key_hex,
+            }
+            for p in pairs
+        ],
+        "group_toml": toml_dumps(group.to_dict()),
+        "group_hash": group.hash().hex(),
+        "genesis_seed": genesis_seed.hex(),
+        "distributed": {
+            "secret": format(poly.secret(), "064x"),
+            "commits": [ref.g1_to_bytes(c).hex() for c in commits],
+            "shares": [
+                {"index": s.index, "value": format(s.value, "064x")}
+                for s in shares
+            ],
+            "lagrange_basis_at_zero_0..2": [
+                format(lam[i], "064x") for i in range(t)
+            ],
+        },
+        "round1": {
+            "message": msg1.hex(),
+            "partials": [p.hex() for p in partials],
+            "signature": sig1.hex(),
+            "randomness": randomness(sig1).hex(),
+        },
+        "round2": {
+            "message": msg2.hex(),
+            "partials": [p.hex() for p in partials2],
+            "signature": sig2.hex(),
+            "randomness": randomness(sig2).hex(),
+        },
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    v = build_vectors()
+    text = json.dumps(v, indent=2)
+    if args.out:
+        Path(args.out).write_text(text)
+        print(f"wrote {args.out}")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
