@@ -14,8 +14,9 @@ The baseline target is 50_000 pairings/sec/chip (BASELINE.json: verify 1M
 rounds < 60 s); vs_baseline = achieved_pairings_per_sec / 50_000.
 
 Environment knobs:
-  BENCH_BATCH   rounds per device call   (default 512)
+  BENCH_BATCH   rounds per device call   (default 1024)
   BENCH_ITERS   timed iterations         (default 4)
+  BENCH_KERNEL  "pallas" (default: the mega-kernel) or "opgraph"
 """
 
 import json
@@ -33,7 +34,7 @@ def main() -> None:
     from drand_tpu.crypto import refimpl as ref
     from drand_tpu.ops import curve, fp, pairing, tower
 
-    batch = int(os.environ.get("BENCH_BATCH", "512"))
+    batch = int(os.environ.get("BENCH_BATCH", "1024"))
     iters = int(os.environ.get("BENCH_ITERS", "4"))
 
     # --- build a valid workload ------------------------------------------
@@ -69,7 +70,13 @@ def main() -> None:
     p1 = jnp.broadcast_to(enc_g1(neg_g), (batch, 2, fp.NLIMB))
     p2 = jnp.broadcast_to(enc_g1(pk), (batch, 2, fp.NLIMB))
 
-    check = jax.jit(pairing.pairing_product_check)
+    kernel = os.environ.get("BENCH_KERNEL", "pallas")
+    if kernel == "pallas":
+        from drand_tpu.ops import pallas_pairing
+
+        check = jax.jit(pallas_pairing.pairing_product_check)
+    else:
+        check = jax.jit(pairing.pairing_product_check)
 
     # warmup / compile (excluded from timing)
     ok = np.asarray(check(p1, q1, p2, q2))
@@ -94,6 +101,7 @@ def main() -> None:
         "detail": {
             "rounds_per_sec": round(rounds_per_sec, 1),
             "batch": batch,
+            "kernel": kernel,
             "iters": iters,
             "seconds": round(dt, 3),
             "device": str(jax.devices()[0]),

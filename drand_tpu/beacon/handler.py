@@ -284,10 +284,10 @@ class BeaconHandler:
         await asyncio.to_thread(
             verify_beacon, self.scheme, self.dist_key, beacon
         )
-        # the head may have advanced while we were collecting (sync race)
+        # the head may have advanced while we were collecting — a benign
+        # sync race, not a failure (the chain moved on without us)
         cur_head = self.store.last()
         if cur_head is not None and cur_head.round >= round:
-            _rounds_failed.inc()
             return
         self.store.put(beacon)
         _rounds_total.inc()

@@ -65,7 +65,8 @@ def available() -> bool:
     try:
         _load()
         return True
-    except RuntimeError:
+    except (RuntimeError, OSError):
+        # OSError: a stale/foreign shared object that CDLL refuses
         return False
 
 

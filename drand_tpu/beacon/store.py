@@ -105,6 +105,18 @@ def open_store(path: str = ":memory:", backend: str = "auto",
         except (RuntimeError, OSError):
             if backend == "native":
                 raise
+    # refuse to garble an existing native-format chain through sqlite
+    if path != ":memory:":
+        try:
+            with open(path, "rb") as fh:
+                if fh.read(8) == b"DTCSTOR1":
+                    raise RuntimeError(
+                        f"{path} holds a native-format chain but the "
+                        "native store backend is unavailable "
+                        "(no C++ toolchain?)"
+                    )
+        except FileNotFoundError:
+            pass
     return BeaconStore(path)
 
 

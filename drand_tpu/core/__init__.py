@@ -5,4 +5,7 @@ the `Drand` daemon, its control-plane handlers, the verifying client
 library, and configuration."""
 
 from drand_tpu.core.daemon import Config, Drand  # noqa: F401
-from drand_tpu.core.client import DrandClient  # noqa: F401
+from drand_tpu.core.client import (  # noqa: F401
+    DrandClient,
+    RestClient,
+)

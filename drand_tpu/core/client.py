@@ -71,3 +71,86 @@ class DrandClient:
 
     async def group(self, peer: Identity) -> str:
         return await self._net.group(peer)
+
+
+class RestClient:
+    """Verifying client over the JSON REST gateway.
+
+    Mirrors /root/reference/net/client_rest.go (`restClient:20`,
+    `PublicRand:45`): same hex-JSON surface, same refusal to return
+    unverified randomness as the gRPC client."""
+
+    def __init__(self, dist_key, base_url: str,
+                 scheme: Optional[tbls.Scheme] = None):
+        self.dist_key = dist_key
+        self.base_url = base_url.rstrip("/")
+        self.scheme = scheme or tbls.default_scheme()
+        self._session = None
+
+    async def _http(self):
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self):
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def _verify_json(self, j: dict) -> Beacon:
+        b = Beacon(
+            round=int(j["round"]),
+            prev_round=int(j.get("previous_round", 0)),
+            prev_sig=bytes.fromhex(j.get("previous", "")),
+            signature=bytes.fromhex(j["signature"]),
+        )
+        msg = beacon_message(b.prev_sig, b.prev_round, b.round)
+        try:
+            self.scheme.verify_recovered(self.dist_key, msg, b.signature)
+        except tbls.ThresholdError as exc:
+            raise VerificationError(str(exc)) from exc
+        rnd = j.get("randomness")
+        if rnd and bytes.fromhex(rnd) != randomness(b.signature):
+            raise VerificationError("randomness != SHA-256(signature)")
+        return b
+
+    async def _get_json(self, path: str) -> dict:
+        http = await self._http()
+        async with http.get(f"{self.base_url}{path}") as resp:
+            if resp.status != 200:
+                raise VerificationError(
+                    f"GET {path}: HTTP {resp.status}"
+                )
+            return await resp.json()
+
+    async def last_public(self) -> Beacon:
+        return self._verify_json(await self._get_json("/api/public"))
+
+    async def public(self, round: int) -> Beacon:
+        return self._verify_json(
+            await self._get_json(f"/api/public/{round}")
+        )
+
+    async def private(self, peer_key) -> bytes:
+        """Private randomness over REST (POST /api/private)."""
+        eph = rand_scalar()
+        eph_pub = ref.g1_mul(ref.G1_GEN, eph)
+        request = ecies.encrypt(peer_key, ref.g1_to_bytes(eph_pub))
+        http = await self._http()
+        async with http.post(
+            f"{self.base_url}/api/private",
+            json={"request": request.hex()},
+        ) as resp:
+            if resp.status != 200:
+                raise VerificationError(f"HTTP {resp.status}")
+            j = await resp.json()
+        out = ecies.decrypt(eph, bytes.fromhex(j["response"]))
+        if len(out) != 32:
+            raise VerificationError("expected 32 bytes of randomness")
+        return out
+
+    async def distkey(self) -> list:
+        j = await self._get_json("/api/info/distkey")
+        return j["coefficients"]

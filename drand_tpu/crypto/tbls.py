@@ -187,12 +187,32 @@ class JaxScheme(Scheme):
 
     def __init__(self):
         # deferred heavy imports so pure-protocol users never pay for jax
+        import os
+
+        import jax
+        import jax.numpy as jnp
+
         from drand_tpu.ops import curve, msm, pairing  # noqa
 
         self._curve, self._msm, self._pairing = curve, msm, pairing
-        import jax.numpy as jnp
-
         self._jnp = jnp
+        # pairing backend: the Pallas mega-kernel on real accelerators,
+        # the op-graph path on CPU (Pallas-TPU doesn't lower there).
+        # Override with DRAND_TPU_PAIRING=opgraph|pallas.
+        choice = os.environ.get("DRAND_TPU_PAIRING", "auto")
+        # auto: Mosaic kernels lower on TPU targets only — never pick
+        # them for GPU/CPU backends
+        backend = jax.default_backend().lower()
+        is_tpu = "tpu" in backend or backend == "axon"
+        use_pallas = (choice == "pallas") or (
+            choice == "auto" and is_tpu
+        )
+        if use_pallas:
+            from drand_tpu.ops import pallas_pairing
+
+            self._check = pallas_pairing.pairing_product_check
+        else:
+            self._check = pairing.pairing_product_check
 
     # -- encode helpers ---------------------------------------------------
 
@@ -288,9 +308,7 @@ class JaxScheme(Scheme):
         p2 = self._jnp.stack([self._enc_g1(pks[i]) for i in rows])
         q2 = self._jnp.stack([self._enc_g2(h)] * nb)
         with _kernel_seconds["pairing_check"].time():
-            ok = np.asarray(
-                self._pairing.pairing_product_check(p1, q1, p2, q2)
-            )
+            ok = np.asarray(self._check(p1, q1, p2, q2))
         out = [False] * len(partials)
         for j, i in enumerate(live):
             out[i] = bool(ok[j])
@@ -321,9 +339,7 @@ class JaxScheme(Scheme):
         p2 = self._jnp.stack([self._enc_g1(pub_key)] * nb)
         q2 = self._jnp.stack([self._enc_g2(hs[i]) for i in rows])
         with _kernel_seconds["pairing_check"].time():
-            ok = np.asarray(
-                self._pairing.pairing_product_check(p1, q1, p2, q2)
-            )
+            ok = np.asarray(self._check(p1, q1, p2, q2))
         out = [False] * len(sigs)
         for j, i in enumerate(live):
             out[i] = bool(ok[j])

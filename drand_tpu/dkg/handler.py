@@ -145,10 +145,14 @@ class DKGHandler:
         """Inbound DKG packet (reference Process dkg/dkg.go:164)."""
         if self._done:
             return
+        # ANY first contact triggers our own dealing (non-leader path).
+        # Responses count too: in a reshare, old-only nodes never receive
+        # deals (deals go to new members only) yet must deal themselves —
+        # the reference starts their DKG on the first reshare packet of
+        # any kind (core/drand_public.go:45-49).
+        self._arm_timer()
+        await self._send_deals()
         if "dkg_deal" in packet:
-            # first contact triggers our own dealing (non-leader path)
-            self._arm_timer()
-            await self._send_deals()
             deal = Deal.from_dict(packet["dkg_deal"])
             try:
                 resp = self.dkg.process_deal(deal)

@@ -242,10 +242,17 @@ class DistKeyGenerator:
 
     # -- certification ----------------------------------------------------
 
+    def _have_deal(self, d: int) -> bool:
+        """Whether we hold dealer d's sub-share — vacuously true for an
+        old-only resharing node (index None): it receives no deals at all
+        and certifies purely from the response broadcast, like the
+        reference's retiring nodes."""
+        return self.index is None or d in self._received
+
     def _certified_dealers(self) -> List[int]:
         out = []
         for d, verifiers in self._approvals.items():
-            if len(verifiers) >= self.threshold and d in self._received:
+            if len(verifiers) >= self.threshold and self._have_deal(d):
                 out.append(d)
         return sorted(out)
 
@@ -254,7 +261,7 @@ class DistKeyGenerator:
         n = len(self.participants)
         dealers = range(len(self.old_participants))
         return all(
-            len(self._approvals.get(d, ())) >= n and d in self._received
+            len(self._approvals.get(d, ())) >= n and self._have_deal(d)
             for d in dealers
         )
 

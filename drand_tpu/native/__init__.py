@@ -22,7 +22,12 @@ _BUILD_ERROR: Optional[str] = None
 
 
 def _source_digest(src: Path) -> str:
-    return hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    """Digest over source AND target platform: a cached artifact built
+    for another architecture must never be picked up."""
+    import platform
+
+    tag = f"{platform.system()}-{platform.machine()}".encode()
+    return hashlib.sha256(src.read_bytes() + b"\0" + tag).hexdigest()[:16]
 
 
 def shared_lib(name: str) -> Optional[str]:

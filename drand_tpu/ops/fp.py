@@ -233,6 +233,65 @@ def mont_sqr(a: jnp.ndarray) -> jnp.ndarray:
     return mont_mul(a, a)
 
 
+# --------------------------------------------------------------------------
+# Lazy (wide) arithmetic: keep products unreduced, REDC once per output.
+#
+# A "wide" value is a NWIDE-limb carried vector (limbs <= B) holding an
+# unreduced product or a small signed combination of products offset back
+# to non-negative.  Chains like Karatsuba towers combine wide values with
+# adds/subs and reduce ONCE per output coefficient — e.g. an Fp2 multiply
+# spends 2 REDCs instead of 3, an Fp12 multiply 12 instead of 54.
+#
+# Bound budget (self-consistent): operands into `mul_wide` are public-op
+# outputs (< 2^387), so raw products are < 2^774 and carried wide limbs
+# vanish above index 65.  The subtraction offset W_SUB (~1.5 * 2^792,
+# multiple of p) limb-wise dominates any carried wide value, and
+# redc input stays < 2^795 << B^NWIDE, giving redc outputs
+# < 2^795/2^408 + p < 2^387 — closing the loop.
+# --------------------------------------------------------------------------
+
+
+def _make_wide_sub_offset() -> np.ndarray:
+    """Multiple of p covering carried wide values limb-wise (cf. M_SUB)."""
+    s = sum(0x1800 << (BITS * i) for i in range(66))
+    k = -(-s // P)  # ceil
+    d = k * P - s   # in [0, p): digits vanish above limb 31
+    assert 0 <= d < P
+    m = int_to_limbs(d, NWIDE)
+    m[:66] += 0x1800
+    assert limbs_to_int(m) % P == 0
+    return m.astype(np.int32)
+
+
+W_SUB = _make_wide_sub_offset()
+
+
+@jax.jit
+def mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Unreduced product as a carried wide vector: (..., NWIDE)."""
+    a = _carry(a, NLIMB)
+    b = _carry(b, NLIMB)
+    return _carry(_conv(a, b), NWIDE)
+
+
+@jax.jit
+def redc(t: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery reduction of a carried wide value: t -> t/R mod p.
+
+    Same algebra as the tail of `mont_mul`; see there for the exactness
+    argument (low NLIMB limbs of t + m p are exactly 0 or R)."""
+    m = _conv(t[..., :NLIMB], jnp.asarray(NP_LIMBS))[..., :NLIMB]
+    m = _carry(m, NLIMB, drop_overflow=True)
+    mp = _conv(m, jnp.asarray(P_LIMBS))
+    pad = [(0, 0)] * (mp.ndim - 1) + [(0, NWIDE - mp.shape[-1])]
+    s = t + jnp.pad(mp, pad)
+    s = _carry(s, NWIDE)
+    c = jnp.any(s[..., :NLIMB] != 0, axis=-1).astype(DTYPE)
+    out = s[..., NLIMB : 2 * NLIMB]
+    out = out.at[..., 0].add(c)
+    return out
+
+
 @jax.jit
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Field addition (lazy: limb add, carry sweep, one top fold)."""

@@ -199,14 +199,16 @@ def miller_loop(p_affine, q_affine):
         f, t = state
         a2, b2, c2 = _line_dbl(t, px, py)
         t = point_double(t, F2)
-        f = tower.fp12_mul_by_line(tower.fp12_sqr(f), a2, b2, c2)
+        f = tower.fp12_mul_by_line_lazy(
+            tower.fp12_sqr_lazy(f), a2, b2, c2
+        )
         return f, t
 
     def add_step(state):
         f, t = state
         a2, b2, c2 = _line_add(t, xq, yq, px, py)
         t = point_add(t, q_proj, F2)
-        f = tower.fp12_mul_by_line(f, a2, b2, c2)
+        f = tower.fp12_mul_by_line_lazy(f, a2, b2, c2)
         return f, t
 
     state = (tower.fp12_one(px.shape[:-1]), q_proj)
@@ -228,9 +230,9 @@ def _pow_cyc(a, e: int):
     bits = [int(c) for c in bin(e)[3:]]  # after the leading one
     return _segment_scan(
         a, bits,
-        sqr_step=tower.fp12_cyclotomic_sqr,
-        mul_step=lambda s: tower.fp12_mul(
-            tower.fp12_cyclotomic_sqr(s), a
+        sqr_step=tower.fp12_cyclotomic_sqr_lazy,
+        mul_step=lambda s: tower.fp12_mul_lazy(
+            tower.fp12_cyclotomic_sqr_lazy(s), a
         ),
     )
 
@@ -239,20 +241,20 @@ def _pow_cyc(a, e: int):
 def final_exponentiation(f):
     """f^(3 (p^12-1)/r) — the cubed pairing (see module docstring)."""
     # easy part: f^((p^6-1)(p^2+1)) — lands in the unitary subgroup
-    t = tower.fp12_mul(tower.fp12_conj(f), tower.fp12_inv(f))
-    t = tower.fp12_mul(tower.fp12_frob2(t), t)
+    t = tower.fp12_mul_lazy(tower.fp12_conj(f), tower.fp12_inv(f))
+    t = tower.fp12_mul_lazy(tower.fp12_frob2(t), t)
     # hard part (cubed): t^((x-1)^2 (x+p) (x^2+p^2-1)) * t^3
     e1 = X_ABS + 1  # |x - 1| for negative x
     a = tower.fp12_conj(_pow_cyc(t, e1))
     a = tower.fp12_conj(_pow_cyc(a, e1))
-    b = tower.fp12_mul(tower.fp12_conj(_pow_cyc(a, X_ABS)),
-                       tower.fp12_frob1(a))
-    c = tower.fp12_mul(
+    b = tower.fp12_mul_lazy(tower.fp12_conj(_pow_cyc(a, X_ABS)),
+                            tower.fp12_frob1(a))
+    c = tower.fp12_mul_lazy(
         _pow_cyc(_pow_cyc(b, X_ABS), X_ABS),
-        tower.fp12_mul(tower.fp12_frob2(b), tower.fp12_conj(b)),
+        tower.fp12_mul_lazy(tower.fp12_frob2(b), tower.fp12_conj(b)),
     )
-    t3 = tower.fp12_mul(tower.fp12_sqr(t), t)
-    return tower.fp12_mul(c, t3)
+    t3 = tower.fp12_mul_lazy(tower.fp12_cyclotomic_sqr_lazy(t), t)
+    return tower.fp12_mul_lazy(c, t3)
 
 
 @jax.jit
@@ -269,5 +271,5 @@ def pairing_product_check(p1, q1, p2, q2):
     Q1 = sig, P2 = pk, Q2 = H(m), truth means e(G, sig) == e(pk, H(m)).
     All four arguments are affine batched points.
     """
-    f = tower.fp12_mul(miller_loop(p1, q1), miller_loop(p2, q2))
+    f = tower.fp12_mul_lazy(miller_loop(p1, q1), miller_loop(p2, q2))
     return tower.fp12_is_one(final_exponentiation(f))

@@ -89,6 +89,11 @@ _CONSTS = {
     "ONE_MONT": [int(v) for v in fpx.ONE_MONT],
     "P": P_L,
     "B3": _mont_limbs(12),  # twist 3b = 12 + 12u (same limb col per comp)
+    # wide-domain subtraction offset (fp.W_SUB), split into NL-row slots
+    # (W_SUB's limbs above index 66 are zero, so two NL-row slots + one
+    # implicit zero row cover all NW = 2*NL + 1 rows)
+    "W_SUB_LO": [int(v) for v in fpx.W_SUB[:NL]],
+    "W_SUB_HI": [int(v) for v in fpx.W_SUB[NL : 2 * NL]],
 }
 for _k in range(6):
     _g = ref.fp2_pow(_G1F, _k)
@@ -239,6 +244,45 @@ def f_mul(a, b):
     out = s[NL : 2 * NL]
     out = jnp.concatenate([out[0:1] + c, out[1:]], axis=0)
     return out
+
+
+NW = 2 * NL + 1
+
+
+def _w_sub_col():
+    """The (NW, 1) column of the wide subtraction offset."""
+    return jnp.concatenate(
+        [_cc("W_SUB_LO"), _cc("W_SUB_HI"),
+         jnp.zeros((1, 1), jnp.int32)],
+        axis=0,
+    )
+
+
+def f_mul_wide(a, b):
+    """Unreduced product as a carried (NW, B) vector (fp.mul_wide)."""
+    if a.shape[1] != b.shape[1]:
+        lanes = max(a.shape[1], b.shape[1])
+        a = jnp.broadcast_to(a, (a.shape[0], lanes))
+        b = jnp.broadcast_to(b, (b.shape[0], lanes))
+    a = _carry(a, NL)
+    b = _carry(b, NL)
+    return _carry(_conv(a, b), NW)
+
+
+def f_redc(t):
+    """Montgomery reduction of a carried wide value (fp.redc)."""
+    m = _conv_const(t[:NL], NP_L, NL)
+    m = _carry(m, NL)
+    m = jnp.concatenate([m[: NL - 1], m[NL - 1 :] & MASK], axis=0)
+    mp = _conv_const(m, P_L, 2 * NL - 1)
+    s = t + jnp.concatenate(
+        [mp, jnp.zeros((NW - (2 * NL - 1), mp.shape[1]), jnp.int32)],
+        axis=0,
+    )
+    s = _carry(s, NW)
+    c = jnp.any(s[:NL] != 0, axis=0, keepdims=True).astype(jnp.int32)
+    out = s[NL : 2 * NL]
+    return jnp.concatenate([out[0:1] + c, out[1:]], axis=0)
 
 
 def f_add(a, b):
@@ -482,6 +526,220 @@ def fp12_conj(a):
     return (a[0], fp6_neg(a[1]))
 
 
+# ---------------------------------------------------------------------------
+# Lazy-reduction tower (mirrors ops/tower.py *_lazy): every base product
+# is computed once as a wide (NW, B) array, combined SYMBOLICALLY (an
+# integer-coefficient linear combination tracked in Python at trace
+# time), and each fp12 output coefficient reduces ONCE.  Crucially, the
+# subtraction offset (nneg copies of fp.W_SUB) is applied only at
+# materialization, against RAW products — never against values that
+# already contain offsets — so carried subtrahend limbs stay within the
+# offset's limb-wise cover (the bound that a chained wide_sub/add
+# formulation violates; see ops/tower.py's _Wd notes).
+# ---------------------------------------------------------------------------
+
+
+class _PSym:
+    """Trace-time linear combination {product_index: coeff}."""
+
+    __slots__ = ("c",)
+
+    def __init__(self, c):
+        self.c = c
+
+    def __add__(self, o):
+        out = dict(self.c)
+        for k, v in o.c.items():
+            out[k] = out.get(k, 0) + v
+        return _PSym(out)
+
+    def __sub__(self, o):
+        out = dict(self.c)
+        for k, v in o.c.items():
+            out[k] = out.get(k, 0) - v
+        return _PSym(out)
+
+    def muls(self, k):
+        return _PSym({i: v * k for i, v in self.c.items()})
+
+
+def _p_xi(p):
+    re, im = p
+    return (re - im, re + im)
+
+
+class _PRec:
+    """Recorder over in-kernel (NL, B) narrow arrays."""
+
+    def __init__(self):
+        self.wides = []
+
+    def prod(self, xa, xb):
+        self.wides.append(f_mul_wide(xa, xb))
+        return _PSym({len(self.wides) - 1: 1})
+
+    def fp2_mul(self, a, b):
+        m0 = self.prod(a[0], b[0])
+        m1 = self.prod(a[1], b[1])
+        m2 = self.prod(f_add(a[0], a[1]), f_add(b[0], b[1]))
+        return (m0 - m1, m2 - m0 - m1)
+
+    def fp2_sqr(self, a):
+        m0 = self.prod(f_add(a[0], a[1]), f_sub(a[0], a[1]))
+        m1 = self.prod(a[0], a[1])
+        return (m0, m1.muls(2))
+
+    def fp6_mul(self, a, b):
+        a0, a1, a2 = a
+        b0, b1, b2 = b
+        v0 = self.fp2_mul(a0, b0)
+        v1 = self.fp2_mul(a1, b1)
+        v2 = self.fp2_mul(a2, b2)
+        t12 = self.fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2))
+        t01 = self.fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1))
+        t02 = self.fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2))
+        c0 = _pp_add(v0, _p_xi(_pp_sub(t12, _pp_add(v1, v2))))
+        c1 = _pp_add(_pp_sub(t01, _pp_add(v0, v1)), _p_xi(v2))
+        c2 = _pp_add(_pp_sub(t02, _pp_add(v0, v2)), v1)
+        return (c0, c1, c2)
+
+    def fp6_mul_sparse2(self, x, A, B):
+        x0, x1, x2 = x
+        v0 = self.fp2_mul(x0, A)
+        v1 = self.fp2_mul(x1, B)
+        t01 = self.fp2_mul(fp2_add(x0, x1), fp2_add(A, B))
+        t02 = self.fp2_mul(fp2_add(x0, x2), A)
+        t12 = self.fp2_mul(fp2_add(x1, x2), B)
+        c0 = _pp_add(v0, _p_xi(_pp_sub(t12, v1)))
+        c1 = _pp_sub(t01, _pp_add(v0, v1))
+        c2 = _pp_add(_pp_sub(t02, v0), v1)
+        return (c0, c1, c2)
+
+    def materialize(self, sym):
+        """pos − neg + nneg·W_SUB, carried, then one REDC."""
+        pos = None
+        neg = None
+        nneg = 0
+        for idx, cf in sym.c.items():
+            if cf == 0:
+                continue
+            w = self.wides[idx]
+            term = w if abs(cf) == 1 else w * abs(cf)
+            if cf > 0:
+                pos = term if pos is None else pos + term
+            else:
+                nneg += abs(cf)
+                neg = term if neg is None else neg + term
+        acc = pos
+        if neg is not None:
+            acc = acc - neg + _w_sub_col() * nneg
+        return f_redc(_carry(acc, NW, passes=2))
+
+
+def _pp_add(x, y):
+    return (x[0] + y[0], x[1] + y[1])
+
+
+def _pp_sub(x, y):
+    return (x[0] - y[0], x[1] - y[1])
+
+
+def _pp6_add(x, y):
+    return tuple(_pp_add(a, b) for a, b in zip(x, y))
+
+
+def _pp6_sub(x, y):
+    return tuple(_pp_sub(a, b) for a, b in zip(x, y))
+
+
+def _pp6_mul_v(x):
+    return (_p_xi(x[2]), x[0], x[1])
+
+
+def _pp12_out(rec, c0, c1):
+    return (
+        tuple(
+            (rec.materialize(c[0]), rec.materialize(c[1])) for c in c0
+        ),
+        tuple(
+            (rec.materialize(c[0]), rec.materialize(c[1])) for c in c1
+        ),
+    )
+
+
+def fp12_mul_lazy(a, b):
+    rec = _PRec()
+    t0 = rec.fp6_mul(a[0], b[0])
+    t1 = rec.fp6_mul(a[1], b[1])
+    t2 = rec.fp6_mul(fp6_add(a[0], a[1]), fp6_add(b[0], b[1]))
+    c0 = _pp6_add(t0, _pp6_mul_v(t1))
+    c1 = _pp6_sub(t2, _pp6_add(t0, t1))
+    return _pp12_out(rec, c0, c1)
+
+
+def fp12_sqr_lazy(a):
+    rec = _PRec()
+    t = rec.fp6_mul(a[0], a[1])
+    u = rec.fp6_mul(
+        fp6_add(a[0], a[1]), fp6_add(a[0], fp6_mul_by_v(a[1]))
+    )
+    c0 = _pp6_sub(u, _pp6_add(t, _pp6_mul_v(t)))
+    c1 = tuple((tc[0].muls(2), tc[1].muls(2)) for tc in t)
+    return _pp12_out(rec, c0, c1)
+
+
+def fp12_mul_by_line_lazy(f, a2, b2, c2):
+    rec = _PRec()
+    f0, f1 = f
+    t0 = rec.fp6_mul_sparse2(f0, a2, b2)
+    y0, y1, y2 = f1
+    t1 = (_p_xi(rec.fp2_mul(y2, c2)), rec.fp2_mul(y0, c2),
+          rec.fp2_mul(y1, c2))
+    t2 = rec.fp6_mul_sparse2(fp6_add(f0, f1), a2, fp2_add(b2, c2))
+    c0 = _pp6_add(t0, _pp6_mul_v(t1))
+    c1 = _pp6_sub(t2, _pp6_add(t0, t1))
+    return _pp12_out(rec, c0, c1)
+
+
+def fp12_cyclotomic_sqr_lazy(a):
+    """Granger–Scott, lazily reduced: the six scaled Fp4-pairs reduce
+    once each; the ±2z corrections are cheap narrow ops after."""
+    a0, a1 = a
+    z0, z2, z4 = a0
+    z1, z3, z5 = a1
+    rec = _PRec()
+
+    def pair(x, y):
+        sx = rec.fp2_sqr(x)
+        sy = rec.fp2_sqr(y)
+        sxy = rec.fp2_sqr(fp2_add(x, y))
+        t = _pp_add(sx, _p_xi(sy))
+        c = _pp_sub(sxy, _pp_add(sx, sy))
+        return t, c
+
+    ta, ca = pair(z0, z3)
+    tb, cb = pair(z1, z4)
+    tc, cc = pair(z2, z5)
+
+    red = [
+        (rec.materialize(x[0].muls(3)), rec.materialize(x[1].muls(3)))
+        for x in (ta, tb, tc, _p_xi(cc), ca, cb)
+    ]
+
+    def lo(t3, z):
+        return (f_sub(t3[0], f_muls(z[0], 2)),
+                f_sub(t3[1], f_muls(z[1], 2)))
+
+    def hi(c3, z):
+        return (f_add(c3[0], f_muls(z[0], 2)),
+                f_add(c3[1], f_muls(z[1], 2)))
+
+    return (
+        (lo(red[0], z0), lo(red[1], z2), lo(red[2], z4)),
+        (hi(red[3], z1), hi(red[4], z3), hi(red[5], z5)),
+    )
+
+
 def fp12_one(b):
     return (fp6_one(b), fp6_zero(b))
 
@@ -591,8 +849,8 @@ def _pow_cyc(a, e: int):
     bits = [int(c) for c in bin(e)[3:]]  # after the leading one
     return _segment_scan(
         a, bits,
-        sqr_step=fp12_cyclotomic_sqr,
-        mul_step=lambda s: fp12_mul(fp12_cyclotomic_sqr(s), a),
+        sqr_step=fp12_cyclotomic_sqr_lazy,
+        mul_step=lambda s: fp12_mul_lazy(fp12_cyclotomic_sqr_lazy(s), a),
         to_stack=_fp12_to_stack,
         from_stack=_stack_to_fp12,
     )
@@ -737,14 +995,14 @@ def _miller(px, py, xq, yq, b):
         f, t = state
         a2, bb2, c2 = _line_dbl(t, px, py)
         t = point_double2(t)
-        f = fp12_mul_by_line(fp12_sqr(f), a2, bb2, c2)
+        f = fp12_mul_by_line_lazy(fp12_sqr_lazy(f), a2, bb2, c2)
         return f, t
 
     def add_step(state):
         f, t = state
         a2, bb2, c2 = _line_add(t, xq, yq, px, py)
         t = point_add2(t, (xq, yq, fp2_one(b)))
-        f = fp12_mul_by_line(f, a2, bb2, c2)
+        f = fp12_mul_by_line_lazy(f, a2, bb2, c2)
         return f, t
 
     def to_stack(state):
@@ -795,20 +1053,20 @@ def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
         (q_ref[6 * NL : 7 * NL], q_ref[7 * NL : 8 * NL]),
         b,
     )
-    g = fp12_mul(f1, f2)
+    g = fp12_mul_lazy(f1, f2)
 
     # final exponentiation (cubed; see ops/pairing.py)
-    t0 = fp12_mul(fp12_conj(g), fp12_inv(g))
-    t0 = fp12_mul(fp12_frob2(t0), t0)
+    t0 = fp12_mul_lazy(fp12_conj(g), fp12_inv(g))
+    t0 = fp12_mul_lazy(fp12_frob2(t0), t0)
     a = fp12_conj(_pow_cyc(t0, X_ABS + 1))
     a = fp12_conj(_pow_cyc(a, X_ABS + 1))
-    bb = fp12_mul(fp12_conj(_pow_cyc(a, X_ABS)), fp12_frob1(a))
-    c = fp12_mul(
+    bb = fp12_mul_lazy(fp12_conj(_pow_cyc(a, X_ABS)), fp12_frob1(a))
+    c = fp12_mul_lazy(
         _pow_cyc(_pow_cyc(bb, X_ABS), X_ABS),
-        fp12_mul(fp12_frob2(bb), fp12_conj(bb)),
+        fp12_mul_lazy(fp12_frob2(bb), fp12_conj(bb)),
     )
-    t3 = fp12_mul(fp12_cyclotomic_sqr(t0), t0)
-    e = fp12_mul(c, t3)
+    t3 = fp12_mul_lazy(fp12_cyclotomic_sqr_lazy(t0), t0)
+    e = fp12_mul_lazy(c, t3)
 
     # canonical is-one comparison
     ok = jnp.ones((1, b), jnp.bool_)
@@ -883,6 +1141,11 @@ def pairing_product_check(p1, q1, p2, q2, block: int = 128,
         ],
         out_specs=pl.BlockSpec(
             (8, block), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        # the lazy-reduction wides keep more live (69, block) buffers on
+        # the kernel stack than the default 16 MiB scoped-vmem budget
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
     )(jnp.asarray(CONSTS_NP), p_all, q_all)

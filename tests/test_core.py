@@ -156,6 +156,29 @@ async def test_full_dkg_beacon_client_rest():
             body = await resp.text()
         assert "drand_beacon_rounds_total" in body
 
+    # verifying REST client (reference net/client_rest.go)
+    from drand_tpu.core import RestClient
+
+    rc = RestClient(dist_key, f"http://127.0.0.1:{rest_port}")
+    rb = await rc.public(1)
+    assert rb == b1
+    last_rb = await rc.last_public()
+    assert last_rb.round >= 2
+    priv2 = await rc.private(daemons[0].pair.public.key)
+    assert len(priv2) == 32
+    assert (await rc.distkey())[0] == dist_hexes[0]
+    # a client keyed with the WRONG collective key refuses the data
+    from drand_tpu.core.client import VerificationError
+
+    bad_rc = RestClient(
+        ref.g1_mul(ref.G1_GEN, 12345),
+        f"http://127.0.0.1:{rest_port}",
+    )
+    with pytest.raises(VerificationError):
+        await bad_rc.public(1)
+    await bad_rc.close()
+    await rc.close()
+
     await client.close()
     for c in ctrls:
         await c.close()
@@ -179,110 +202,115 @@ async def test_daemon_reshare_transition():
         period=PERIOD,
         genesis_time=int(clock.now()) + 60,
     )
-    group_toml = toml_dumps(group.to_dict())
-    ctrls = [ControlClient(p) for p in ctrl_ports]
-    tasks = [
-        asyncio.create_task(ctrls[i].init_dkg(group_toml, is_leader=False))
-        for i in range(1, n)
-    ]
-    await asyncio.sleep(0.3)
-    tasks.insert(0, asyncio.create_task(
-        ctrls[0].init_dkg(group_toml, is_leader=True)
-    ))
-    dist_hexes = await asyncio.wait_for(asyncio.gather(*tasks), 180)
-    assert len(set(dist_hexes)) == 1 and dist_hexes[0]
-    dist_key = ref.g1_from_bytes(bytes.fromhex(dist_hexes[0]))
+    ctrls = []
+    extras = []
+    try:
+        group_toml = toml_dumps(group.to_dict())
+        ctrls.extend(ControlClient(p) for p in ctrl_ports)
+        tasks = [
+            asyncio.create_task(ctrls[i].init_dkg(group_toml, is_leader=False))
+            for i in range(1, n)
+        ]
+        await asyncio.sleep(0.3)
+        tasks.insert(0, asyncio.create_task(
+            ctrls[0].init_dkg(group_toml, is_leader=True)
+        ))
+        dist_hexes = await asyncio.wait_for(asyncio.gather(*tasks), 180)
+        assert len(set(dist_hexes)) == 1 and dist_hexes[0]
+        dist_key = ref.g1_from_bytes(bytes.fromhex(dist_hexes[0]))
 
-    await clock.advance(60)
-    assert await wait_until(
-        lambda: all(
-            d.beacon and d.beacon.store.last()
-            and d.beacon.store.last().round >= 1
-            for d in daemons
-        )
-    ), "round 1 did not complete"
-
-    # new group: daemon 0 retires, daemons 1-3 stay, daemon 4 is new
-    extra_ports = free_ports(2)
-    new_addr = f"127.0.0.1:{extra_ports[0]}"
-    newcomer = await Drand.new(
-        Config(
-            listen_addr=new_addr, control_port=extra_ports[1],
-            clock=clock, in_memory=True,
-        ),
-        Pair.generate(new_addr),
-    )
-    head_round = max(d.beacon.store.last().round for d in daemons)
-    transition_round = head_round + 2
-    new_group = Group(
-        nodes=[d.pair.public for d in daemons[1:]]
-        + [newcomer.pair.public],
-        threshold=3,
-        period=PERIOD,
-        genesis_time=group.genesis_time,
-        transition_time=int(
-            time_of_round(PERIOD, group.genesis_time, transition_round)
-        ),
-    )
-    new_toml = toml_dumps(new_group.to_dict())
-    new_ctrl = ControlClient(extra_ports[1])
-
-    # everyone in old ∪ new participates; leader (an old node) last
-    rtasks = [
-        asyncio.create_task(
-            ctrls[i].init_reshare(new_toml, is_leader=False)
-        )
-        for i in (0, 2, 3)
-    ] + [
-        asyncio.create_task(
-            new_ctrl.init_reshare(
-                new_toml, is_leader=False, old_group_toml=group_toml
+        await clock.advance(60)
+        assert await wait_until(
+            lambda: all(
+                d.beacon and d.beacon.store.last()
+                and d.beacon.store.last().round >= 1
+                for d in daemons
             )
+        ), "round 1 did not complete"
+
+        # new group: daemon 0 retires, daemons 1-3 stay, daemon 4 is new
+        extra_ports = free_ports(2)
+        new_addr = f"127.0.0.1:{extra_ports[0]}"
+        newcomer = await Drand.new(
+            Config(
+                listen_addr=new_addr, control_port=extra_ports[1],
+                clock=clock, in_memory=True,
+            ),
+            Pair.generate(new_addr),
         )
-    ]
-    await asyncio.sleep(0.3)
-    rtasks.insert(0, asyncio.create_task(
-        ctrls[1].init_reshare(new_toml, is_leader=True)
-    ))
-    rres = await asyncio.wait_for(asyncio.gather(*rtasks), 300)
-    # retiring node reports no new key; all members agree on the OLD key
-    assert rres[1] == ""
-    member_keys = {rres[0]} | set(rres[2:])
-    assert member_keys == {dist_hexes[0]}
+        extras.append(newcomer)
+        head_round = max(d.beacon.store.last().round for d in daemons)
+        transition_round = head_round + 2
+        new_group = Group(
+            nodes=[d.pair.public for d in daemons[1:]]
+            + [newcomer.pair.public],
+            threshold=3,
+            period=PERIOD,
+            genesis_time=group.genesis_time,
+            transition_time=int(
+                time_of_round(PERIOD, group.genesis_time, transition_round)
+            ),
+        )
+        new_toml = toml_dumps(new_group.to_dict())
+        new_ctrl = ControlClient(extra_ports[1])
+        ctrls.append(new_ctrl)
 
-    # cross the transition: the new group (incl. the newcomer) produces
-    new_members = daemons[1:] + [newcomer]
-    await clock.advance(PERIOD)
-    await clock.advance(PERIOD)
-    assert await wait_until(
-        lambda: all(
-            d.beacon.store.last().round >= transition_round
-            for d in new_members
-        ),
-        timeout=120,
-    ), "new group did not produce past the transition round"
+        # everyone in old ∪ new participates; leader (an old node) last
+        rtasks = [
+            asyncio.create_task(
+                ctrls[i].init_reshare(new_toml, is_leader=False)
+            )
+            for i in (0, 2, 3)
+        ] + [
+            asyncio.create_task(
+                new_ctrl.init_reshare(
+                    new_toml, is_leader=False, old_group_toml=group_toml
+                )
+            )
+        ]
+        await asyncio.sleep(0.3)
+        rtasks.insert(0, asyncio.create_task(
+            ctrls[1].init_reshare(new_toml, is_leader=True)
+        ))
+        rres = await asyncio.wait_for(asyncio.gather(*rtasks), 300)
+        # retiring node reports no new key; all members agree on the OLD key
+        assert rres[1] == ""
+        member_keys = {rres[0]} | set(rres[2:])
+        assert member_keys == {dist_hexes[0]}
 
-    # the retiring node stopped producing
-    assert daemons[0].beacon.store.last().round < transition_round
+        # cross the transition: the new group (incl. the newcomer) produces
+        new_members = daemons[1:] + [newcomer]
+        await clock.advance(PERIOD)
+        await clock.advance(PERIOD)
+        assert await wait_until(
+            lambda: all(
+                d.beacon.store.last().round >= transition_round
+                for d in new_members
+            ),
+            timeout=120,
+        ), "new group did not produce past the transition round"
 
-    # ONE continuous chain, verifiable with the ORIGINAL collective key
-    scheme = daemons[1].scheme
-    store = newcomer.beacon.store
-    head = store.last()
-    from drand_tpu.beacon import verify_beacon
-    for rnd in range(1, head.round + 1):
-        b = store.get(rnd)
-        if b is None:
-            continue  # ticker-is-king may skip a round under load
-        verify_beacon(scheme, dist_key, b)
-        prev = store.get(b.prev_round)
-        assert prev is not None and prev.signature == b.prev_sig
+        # the retiring node stopped producing
+        assert daemons[0].beacon.store.last().round < transition_round
 
-    await new_ctrl.close()
-    for c in ctrls:
-        await c.close()
-    for d in daemons + [newcomer]:
-        await d.stop()
+        # ONE continuous chain, verifiable with the ORIGINAL collective key
+        scheme = daemons[1].scheme
+        store = newcomer.beacon.store
+        head = store.last()
+        from drand_tpu.beacon import verify_beacon
+        for rnd in range(1, head.round + 1):
+            b = store.get(rnd)
+            if b is None:
+                continue  # ticker-is-king may skip a round under load
+            verify_beacon(scheme, dist_key, b)
+            prev = store.get(b.prev_round)
+            assert prev is not None and prev.signature == b.prev_sig
+
+    finally:
+        for c in ctrls:
+            await c.close()
+        for d in daemons + extras:
+            await d.stop()
 
 
 @pytest.mark.asyncio

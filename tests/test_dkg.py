@@ -215,6 +215,59 @@ async def test_handler_reshare_preserves_collective_key():
     assert new_shares[0].share.value != old_shares[0].share.value
 
 
+@pytest.mark.asyncio
+async def test_handler_reshare_with_retiring_nonleader_node():
+    """Regression: an old-only node that is NOT the leader receives no
+    deals (deals go to new members only) yet must deal itself — its
+    dealing is triggered by the first packet of any kind (reference
+    core/drand_public.go:45-49).  Without that, full certification can
+    never complete and every wait_share() hangs."""
+    old_pairs = make_pairs(4, 31)
+    clock = FakeClock()
+    old_group = Group(nodes=[p.public for p in old_pairs], threshold=3,
+                      genesis_time=int(clock.now()) + 1000)
+    net = DKGNet()
+    handlers = []
+    for p in old_pairs:
+        h = DKGHandler(
+            DKGConfig(pair=p, new_group=old_group, clock=clock), net
+        )
+        net.register(p.public.address, h)
+        handlers.append(h)
+    futs = await drive_dkg(handlers)
+    old_shares = [await asyncio.wait_for(f, 5) for f in futs]
+    dist_key = old_shares[0].commits[0]
+
+    # node 0 retires; nodes 1-3 stay; one brand-new member joins.
+    # leader is node 1 (an old member) — node 0 is old-only AND not
+    # the leader, so nothing but the response broadcast reaches it.
+    new_pairs = old_pairs[1:] + make_pairs(1, 32, base_port=7800)
+    new_group = Group(nodes=[p.public for p in new_pairs], threshold=3,
+                      genesis_time=int(clock.now()) + 1000)
+    net2 = DKGNet()
+    handlers2 = []
+    for i, p in enumerate(old_pairs + new_pairs[-1:]):
+        old_share = old_shares[i] if i < 4 else None
+        h = DKGHandler(
+            DKGConfig(
+                pair=p, new_group=new_group, old_group=old_group,
+                old_share=old_share, clock=clock,
+            ),
+            net2,
+        )
+        net2.register(p.public.address, h)
+        handlers2.append(h)
+    futs2 = await drive_dkg(handlers2, leader=1)
+    shares2 = [await asyncio.wait_for(f, 60) for f in futs2]
+    # retiring node gets no share; members all share the SAME key
+    assert shares2[0] is None
+    members = shares2[1:]
+    assert all(s is not None for s in members)
+    assert all(s.commits[0] == dist_key for s in members)
+    secret = recover_secret([s.share for s in members[:3]], 3)
+    assert ref.g1_mul(ref.G1_GEN, secret) == dist_key
+
+
 def test_ecies_roundtrip_and_tamper():
     pair = make_pairs(1, 27)[0]
     blob = ecies.encrypt(pair.public.key, b"secret share", b"ctx")

@@ -184,15 +184,44 @@ def _unitary(f12):
 def test_cyclotomic_sqr_and_pow_vs_oracle():
     u = _unitary(_rand_fp12())
 
-    def kcyc(s):
-        return pp._fp12_to_stack(
-            pp.fp12_cyclotomic_sqr(pp._stack_to_fp12(
-                [s[k * pp.NL : (k + 1) * pp.NL] for k in range(12)]
-            ))
-        ).reshape(12 * pp.NL, B)
+    for fn in (pp.fp12_cyclotomic_sqr, pp.fp12_cyclotomic_sqr_lazy):
+        def kcyc(s, fn=fn):
+            return pp._fp12_to_stack(
+                fn(pp._stack_to_fp12(
+                    [s[k * pp.NL : (k + 1) * pp.NL] for k in range(12)]
+                ))
+            ).reshape(12 * pp.NL, B)
 
-    out = np.asarray(run_rows(kcyc, 12 * pp.NL, _pack12(u)))
-    assert _unpack12(out) == ref.fp12_mul(u, u)
+        out = np.asarray(run_rows(kcyc, 12 * pp.NL, _pack12(u)))
+        assert _unpack12(out) == ref.fp12_mul(u, u), fn.__name__
+
+    # lazy generic mul + sqr against the oracle
+    g = _rand_fp12()
+
+    def kmul(s, t):
+        a = pp._stack_to_fp12(
+            [s[k * pp.NL : (k + 1) * pp.NL] for k in range(12)]
+        )
+        b = pp._stack_to_fp12(
+            [t[k * pp.NL : (k + 1) * pp.NL] for k in range(12)]
+        )
+        return pp._fp12_to_stack(pp.fp12_mul_lazy(a, b)).reshape(
+            12 * pp.NL, B
+        )
+
+    out = np.asarray(run_rows(kmul, 12 * pp.NL, _pack12(u), _pack12(g)))
+    assert _unpack12(out) == ref.fp12_mul(u, g)
+
+    def ksqr(s):
+        a = pp._stack_to_fp12(
+            [s[k * pp.NL : (k + 1) * pp.NL] for k in range(12)]
+        )
+        return pp._fp12_to_stack(pp.fp12_sqr_lazy(a)).reshape(
+            12 * pp.NL, B
+        )
+
+    out = np.asarray(run_rows(ksqr, 12 * pp.NL, _pack12(g)))
+    assert _unpack12(out) == ref.fp12_mul(g, g)
 
     # small segment-structured pow on the unitary subgroup (e = 0b100100
     # exercises runs, one-bits, and a trailing zero run)
@@ -222,24 +251,25 @@ def test_line_mul_vs_oracle():
              np.stack([col(v[1])] * B, axis=1)], axis=0
         ))
 
-    def kline(s, la, lb, lc):
-        f = pp._stack_to_fp12(
-            [s[k * pp.NL : (k + 1) * pp.NL] for k in range(12)]
-        )
-        out = pp.fp12_mul_by_line(
-            f,
-            (la[: pp.NL], la[pp.NL :]),
-            (lb[: pp.NL], lb[pp.NL :]),
-            (lc[: pp.NL], lc[pp.NL :]),
-        )
-        return pp._fp12_to_stack(out).reshape(12 * pp.NL, B)
-
-    out = np.asarray(run_rows(
-        kline, 12 * pp.NL, _pack12(g), pack2(A), pack2(Bc), pack2(C)
-    ))
     zero2 = (0, 0)
     line = ((A, Bc, zero2), (zero2, C, zero2))
-    assert _unpack12(out) == ref.fp12_mul(g, line)
+    for fn in (pp.fp12_mul_by_line, pp.fp12_mul_by_line_lazy):
+        def kline(s, la, lb, lc, fn=fn):
+            f = pp._stack_to_fp12(
+                [s[k * pp.NL : (k + 1) * pp.NL] for k in range(12)]
+            )
+            out = fn(
+                f,
+                (la[: pp.NL], la[pp.NL :]),
+                (lb[: pp.NL], lb[pp.NL :]),
+                (lc[: pp.NL], lc[pp.NL :]),
+            )
+            return pp._fp12_to_stack(out).reshape(12 * pp.NL, B)
+
+        out = np.asarray(run_rows(
+            kline, 12 * pp.NL, _pack12(g), pack2(A), pack2(Bc), pack2(C)
+        ))
+        assert _unpack12(out) == ref.fp12_mul(g, line), fn.__name__
 
 
 def test_bit_patterns_match():

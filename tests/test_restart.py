@@ -61,7 +61,8 @@ async def test_restart_catchup_over_grpc(tmp_path):
 
     await clock.advance(60)
     assert await wait_until(
-        lambda: all(d.beacon.store.last().round >= 1 for d in daemons)
+        lambda: all(d.beacon.store.last().round >= 1 for d in daemons),
+        timeout=180,
     )
 
     # kill node 3; the others keep producing (threshold 3-of-4)
@@ -69,7 +70,8 @@ async def test_restart_catchup_over_grpc(tmp_path):
     await clock.advance(PERIOD)
     await clock.advance(PERIOD)
     assert await wait_until(
-        lambda: all(d.beacon.store.last().round >= 3 for d in daemons[:3])
+        lambda: all(d.beacon.store.last().round >= 3 for d in daemons[:3]),
+        timeout=180,
     )
 
     # restart node 3 from its durable folders: catches up over gRPC
@@ -80,7 +82,7 @@ async def test_restart_catchup_over_grpc(tmp_path):
     # …and participates in the next round
     await clock.advance(PERIOD)
     assert await wait_until(
-        lambda: restarted.beacon.store.last().round >= 4
+        lambda: restarted.beacon.store.last().round >= 4, timeout=180
     )
     # the synced chain links match the producers' chain exactly
     b2 = restarted.beacon.store.get(2)

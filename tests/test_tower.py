@@ -64,6 +64,39 @@ def test_fp12_ops_vs_oracle():
     assert not bool(tower.fp12_is_one(a))
 
 
+def test_lazy_and_special_ops_vs_oracle():
+    """The lazy-reduction variants and their eager twins (the readable
+    reference forms the pairing used before lazy reduction) must agree
+    with the oracle on the same inputs."""
+    x, y = rand_fp12(), rand_fp12()
+    a, b = tower.fp12_encode(x), tower.fp12_encode(y)
+    want = ref.fp12_mul(x, y)
+    assert tower.fp12_decode(tower.fp12_mul_lazy(a, b)) == want
+    assert tower.fp12_decode(tower.fp12_sqr_lazy(a)) == ref.fp12_sqr(x)
+
+    # cyclotomic squaring needs a unitary element (easy-part output)
+    u = ref.fp12_mul(ref.fp12_conj(x), ref.fp12_inv(x))
+    u = ref.fp12_mul(ref.fp12_frob2(u), u)
+    ue = tower.fp12_encode(u)
+    want = ref.fp12_mul(u, u)
+    assert tower.fp12_decode(tower.fp12_cyclotomic_sqr(ue)) == want
+    assert tower.fp12_decode(tower.fp12_cyclotomic_sqr_lazy(ue)) == want
+
+    # sparse line multiply: A + B v + (C v) w
+    line_abc = [rand_fp2() for _ in range(3)]
+    A, Bc, C = line_abc
+    zero2 = (0, 0)
+    line = ((A, Bc, zero2), (zero2, C, zero2))
+    want = ref.fp12_mul(x, line)
+    ea, eb, ec = (tower.fp2_encode(v) for v in line_abc)
+    assert tower.fp12_decode(
+        tower.fp12_mul_by_line(a, ea, eb, ec)
+    ) == want
+    assert tower.fp12_decode(
+        tower.fp12_mul_by_line_lazy(a, ea, eb, ec)
+    ) == want
+
+
 def test_frobenius_vs_oracle():
     x = rand_fp12()
     a = tower.fp12_encode(x)
