@@ -70,7 +70,11 @@ def main() -> None:
     p1 = jnp.broadcast_to(enc_g1(neg_g), (batch, 2, fp.NLIMB))
     p2 = jnp.broadcast_to(enc_g1(pk), (batch, 2, fp.NLIMB))
 
-    kernel = os.environ.get("BENCH_KERNEL", "pallas")
+    backend = jax.default_backend().lower()
+    default_kernel = (
+        "pallas" if ("tpu" in backend or backend == "axon") else "opgraph"
+    )
+    kernel = os.environ.get("BENCH_KERNEL", default_kernel)
     if kernel == "pallas":
         from drand_tpu.ops import pallas_pairing
 

@@ -149,6 +149,7 @@ class BeaconHandler:
         self._stop_at: Optional[int] = None
         self._loop_task: Optional[asyncio.Task] = None
         self._round_task: Optional[asyncio.Task] = None
+        self._resync_task: Optional[asyncio.Task] = None
         self._stopped = asyncio.Event()
 
     # -- public control ---------------------------------------------------
@@ -189,7 +190,7 @@ class BeaconHandler:
 
     async def stop(self) -> None:
         self._running = False
-        for t in (self._round_task, self._loop_task):
+        for t in (self._round_task, self._loop_task, self._resync_task):
             if t is not None:
                 t.cancel()
         await asyncio.sleep(0)
@@ -256,7 +257,7 @@ class BeaconHandler:
         msg = beacon_message(prev_sig, prev_round, round)
         own = self.scheme.partial_sign(self.cfg.share.share, msg)
         queue = self.manager.new_round(round)
-        self.manager.add_partial(round, own)
+        self.manager.add_partial(round, own, prev_round, prev_sig)
         packet = BeaconPacket(
             from_address=self.cfg.public.address,
             round=round,
@@ -271,7 +272,12 @@ class BeaconHandler:
 
         partials: Dict[int, bytes] = {self.index: own}
         while len(partials) < self.group.threshold:
-            blob = await queue.get()
+            blob, p_prev_round, p_prev_sig = await queue.get()
+            if p_prev_round != prev_round or p_prev_sig != prev_sig:
+                # the signer is on a different chain link than us — its
+                # partial signs a different message and would poison the
+                # Lagrange recovery
+                continue
             partials[self.scheme.index_of(blob)] = blob
 
         sig = await asyncio.to_thread(
@@ -299,6 +305,11 @@ class BeaconHandler:
         if self._stop_at is not None and round >= self._stop_at:
             self._running = False
             self._stopped.set()
+
+    def _schedule_resync(self) -> None:
+        """Fire-and-forget chain sync (at most one in flight)."""
+        if self._resync_task is None or self._resync_task.done():
+            self._resync_task = asyncio.create_task(self.sync())
 
     async def _send_packet(self, node: Identity,
                            packet: BeaconPacket) -> None:
@@ -334,11 +345,21 @@ class BeaconHandler:
         except Exception:
             _partials_rejected.inc()
             raise
+        # a valid partial referencing a chain link AHEAD of our head means
+        # we missed a round: pull the gap from peers (the reference's
+        # recovery is pull-based catch-up, SURVEY §5) so the next round's
+        # message matches the majority's again
+        head = self.store.last()
+        if head is not None and packet.prev_round > head.round:
+            self._schedule_resync()
         idx = self.scheme.index_of(packet.partial_sig)
         if idx == self.index:
             return
         _partials_in.inc()
-        self.manager.add_partial(packet.round, packet.partial_sig)
+        self.manager.add_partial(
+            packet.round, packet.partial_sig,
+            packet.prev_round, packet.prev_sig,
+        )
 
     def sync_chain_from(self, from_round: int) -> List[Beacon]:
         """Serve our chain from a round (reference SyncChain :170-194)."""

@@ -16,12 +16,16 @@ MAX_LOOKAHEAD = 1024
 
 
 class RoundManager:
+    """Entries are (partial_bytes, prev_round, prev_sig): recovery must
+    only combine partials that sign the SAME chain link — mixing a
+    lagging node's link with the majority's yields garbage signatures."""
+
     def __init__(self, index_of):
         self._index_of = index_of          # partial bytes -> signer index
         self._round: Optional[int] = None
         self._queue: Optional[asyncio.Queue] = None
         self._seen: set = set()
-        self._future: Dict[int, List[bytes]] = {}
+        self._future: Dict[int, List[Tuple[bytes, int, bytes]]] = {}
         self._buffered = 0
 
     def new_round(self, round: int) -> asyncio.Queue:
@@ -29,27 +33,29 @@ class RoundManager:
         self._round = round
         self._queue = asyncio.Queue()
         self._seen = set()
-        for blob in self._future.pop(round, []):
+        for entry in self._future.pop(round, []):
             self._buffered -= 1
-            self._offer(blob)
+            self._offer(entry)
         # drop stale buffered rounds
         for r in [r for r in self._future if r <= round]:
             self._buffered -= len(self._future.pop(r))
         return self._queue
 
-    def _offer(self, blob: bytes) -> None:
-        idx = self._index_of(blob)
+    def _offer(self, entry: Tuple[bytes, int, bytes]) -> None:
+        idx = self._index_of(entry[0])
         if idx in self._seen:
             return
         self._seen.add(idx)
         assert self._queue is not None
-        self._queue.put_nowait(blob)
+        self._queue.put_nowait(entry)
 
-    def add_partial(self, round: int, blob: bytes) -> None:
+    def add_partial(self, round: int, blob: bytes,
+                    prev_round: int, prev_sig: bytes) -> None:
+        entry = (blob, prev_round, prev_sig)
         if self._round is not None and round == self._round:
-            self._offer(blob)
+            self._offer(entry)
         elif (self._round is None or round > self._round) and \
                 self._buffered < MAX_LOOKAHEAD:
-            self._future.setdefault(round, []).append(blob)
+            self._future.setdefault(round, []).append(entry)
             self._buffered += 1
         # else: stale round — drop

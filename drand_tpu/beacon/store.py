@@ -110,10 +110,15 @@ def open_store(path: str = ":memory:", backend: str = "auto",
         try:
             with open(path, "rb") as fh:
                 if fh.read(8) == b"DTCSTOR1":
-                    raise RuntimeError(
-                        f"{path} holds a native-format chain but the "
-                        "native store backend is unavailable "
+                    why = (
+                        "the native backend is unavailable "
                         "(no C++ toolchain?)"
+                        if backend == "auto"
+                        else "backend='sqlite' was requested — open it "
+                        "with backend='native' or 'auto'"
+                    )
+                    raise RuntimeError(
+                        f"{path} holds a native-format chain but {why}"
                     )
         except FileNotFoundError:
             pass

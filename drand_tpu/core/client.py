@@ -20,7 +20,12 @@ from drand_tpu.net import CertManager, GrpcClient
 
 
 class VerificationError(Exception):
-    pass
+    """The fetched randomness failed cryptographic verification."""
+
+
+class FetchError(Exception):
+    """Transport-level failure (unreachable node, missing round, …) —
+    retryable, unlike VerificationError."""
 
 
 class DrandClient:
@@ -120,9 +125,7 @@ class RestClient:
         http = await self._http()
         async with http.get(f"{self.base_url}{path}") as resp:
             if resp.status != 200:
-                raise VerificationError(
-                    f"GET {path}: HTTP {resp.status}"
-                )
+                raise FetchError(f"GET {path}: HTTP {resp.status}")
             return await resp.json()
 
     async def last_public(self) -> Beacon:
@@ -144,7 +147,7 @@ class RestClient:
             json={"request": request.hex()},
         ) as resp:
             if resp.status != 200:
-                raise VerificationError(f"HTTP {resp.status}")
+                raise FetchError(f"HTTP {resp.status}")
             j = await resp.json()
         out = ecies.decrypt(eph, bytes.fromhex(j["response"]))
         if len(out) != 32:

@@ -565,6 +565,27 @@ class _Rec:
         return fp.redc(stacked)
 
 
+def _sp_add(x, y):
+    """Symbolic Fp2-pair add (components are _Wd combinations)."""
+    return (x[0] + y[0], x[1] + y[1])
+
+
+def _sp_sub(x, y):
+    return (x[0] - y[0], x[1] - y[1])
+
+
+def _sp6_add(x, y):
+    return tuple(_sp_add(a, b) for a, b in zip(x, y))
+
+
+def _sp6_sub(x, y):
+    return tuple(_sp_sub(a, b) for a, b in zip(x, y))
+
+
+def _sp6_mul_v(x):
+    return (_w_xi(x[2]), x[0], x[1])
+
+
 def _sym12(rec, a, b):
     """Symbolic fp12 Karatsuba multiply -> 12 symbolic Fp components."""
     a0, a1 = _f12(a)
@@ -572,18 +593,8 @@ def _sym12(rec, a, b):
     t0 = rec.fp6_mul(a0, b0)
     t1 = rec.fp6_mul(a1, b1)
     t2 = rec.fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1))
-
-    def p6_add(x, y):
-        return tuple((xc[0] + yc[0], xc[1] + yc[1]) for xc, yc in zip(x, y))
-
-    def p6_sub(x, y):
-        return tuple((xc[0] - yc[0], xc[1] - yc[1]) for xc, yc in zip(x, y))
-
-    def p6_mul_v(x):
-        return (_w_xi(x[2]), x[0], x[1])
-
-    c0 = p6_add(t0, p6_mul_v(t1))
-    c1 = p6_sub(t2, p6_add(t0, t1))
+    c0 = _sp6_add(t0, _sp6_mul_v(t1))
+    c1 = _sp6_sub(t2, _sp6_add(t0, t1))
     return [c0[i][j] for i in range(3) for j in range(2)] + \
            [c1[i][j] for i in range(3) for j in range(2)]
 
@@ -615,17 +626,7 @@ def fp12_sqr_lazy(a):
     rec = _Rec()
     t = rec.fp6_mul(a0, a1)
     u = rec.fp6_mul(fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1)))
-
-    def p6_add(x, y):
-        return tuple((xc[0] + yc[0], xc[1] + yc[1]) for xc, yc in zip(x, y))
-
-    def p6_sub(x, y):
-        return tuple((xc[0] - yc[0], xc[1] - yc[1]) for xc, yc in zip(x, y))
-
-    def p6_mul_v(x):
-        return (_w_xi(x[2]), x[0], x[1])
-
-    c0 = p6_sub(u, p6_add(t, p6_mul_v(t)))
+    c0 = _sp6_sub(u, _sp6_add(t, _sp6_mul_v(t)))
     c1 = tuple((tc[0].muls(2), tc[1].muls(2)) for tc in t)
     flat = [c0[i][j] for i in range(3) for j in range(2)] + \
            [c1[i][j] for i in range(3) for j in range(2)]
@@ -692,8 +693,8 @@ def fp12_mul_by_line_lazy(f, a2, b2, c2):
         t01 = rec.fp2_mul(fp2_add(x0, x1), fp2_add(A, B))
         t02 = rec.fp2_mul(fp2_add(x0, x2), A)
         t12 = rec.fp2_mul(fp2_add(x1, x2), B)
-        c0 = (v0[0] + _w_xi((t12[0] - v1[0], t12[1] - v1[1]))[0],
-              v0[1] + _w_xi((t12[0] - v1[0], t12[1] - v1[1]))[1])
+        t = _w_xi(_sp_sub(t12, v1))
+        c0 = (v0[0] + t[0], v0[1] + t[1])
         c1 = (t01[0] - v0[0] - v1[0], t01[1] - v0[1] - v1[1])
         c2v = (t02[0] - v0[0] + v1[0], t02[1] - v0[1] + v1[1])
         return (c0, c1, c2v)

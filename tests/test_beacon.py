@@ -201,6 +201,45 @@ async def test_beacon_threshold_with_down_node_and_catchup():
 
 
 @pytest.mark.asyncio
+async def test_lagging_node_resyncs_mid_run():
+    """Regression: a node that misses a round must NOT stay desynced.
+
+    Once behind, its round messages chain from an older head than the
+    majority's, so peer partials reference a different link and its own
+    recovery can never succeed.  Receiving a valid partial whose
+    prev_round is ahead of our head must trigger a pull-based resync
+    (reference recovery model, SURVEY §5) — and mismatched-link partials
+    must never be combined in recovery."""
+    clock = FakeClock()
+    group, handlers, net, poly = build_network(4, 3, clock)
+    lag = handlers[3]
+    for h in handlers:
+        await h.start()
+    await clock.advance(10)
+    await wait_for_round(handlers, 1)
+
+    # node 3 goes deaf for one round: the trio advances without it
+    net.down.add(lag.cfg.public.address)
+    await clock.advance(PERIOD)
+    await wait_for_round(handlers[:3], 2)
+    assert lag.store.last().round == 1
+
+    # back online: partials referencing the newer link must trigger a
+    # resync, after which it follows the chain again
+    net.down.discard(lag.cfg.public.address)
+    await clock.advance(PERIOD)
+    await wait_for_round(handlers[:3], 3)
+    await clock.advance(PERIOD)
+    await wait_for_round(handlers, 4)
+
+    # its chain is the SAME chain
+    for rnd in (2, 3, 4):
+        assert lag.store.get(rnd) == handlers[0].store.get(rnd)
+    for h in handlers:
+        await h.stop()
+
+
+@pytest.mark.asyncio
 async def test_sync_rejects_tampered_chain():
     clock = FakeClock()
     group, handlers, net, poly = build_network(4, 3, clock)
