@@ -70,9 +70,13 @@ class Node:
             if check:
                 raise
             # tolerated probe timeout (loaded host): report as rc 124
+            def _txt(v):
+                if isinstance(v, bytes):
+                    return v.decode(errors="replace")
+                return v or ""
+
             r = subprocess.CompletedProcess(
-                cmd, 124, stdout=str(exc.stdout or ""),
-                stderr=str(exc.stderr or ""),
+                cmd, 124, stdout=_txt(exc.stdout), stderr=_txt(exc.stderr)
             )
         if check and r.returncode != 0:
             raise RuntimeError(

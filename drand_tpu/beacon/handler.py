@@ -308,6 +308,8 @@ class BeaconHandler:
 
     def _schedule_resync(self) -> None:
         """Fire-and-forget chain sync (at most one in flight)."""
+        if not self._running:
+            return  # shutting down: don't orphan a sync on a closing store
         if self._resync_task is None or self._resync_task.done():
             self._resync_task = asyncio.create_task(self.sync())
 
@@ -396,13 +398,13 @@ class BeaconHandler:
         async for b in self.client.sync_chain(peer, head.round + 1):
             batch.append(b)
             if len(batch) >= SYNC_BATCH:
-                head = self._verify_and_store(head, batch)
+                head = await self._verify_and_store(head, batch)
                 batch = []
         if batch:
-            self._verify_and_store(head, batch)
+            await self._verify_and_store(head, batch)
 
-    def _verify_and_store(self, head: Beacon,
-                          batch: List[Beacon]) -> Beacon:
+    async def _verify_and_store(self, head: Beacon,
+                                batch: List[Beacon]) -> Beacon:
         # chain-link checks (cheap, host side)
         prev = head
         for b in batch:
@@ -417,7 +419,11 @@ class BeaconHandler:
             for b in batch
         ]
         sigs = [b.signature for b in batch]
-        ok = self.scheme.verify_chain_batch(self.dist_key, msgs, sigs)
+        # mid-run resyncs share the event loop with live round intake:
+        # the batched pairing check runs off-loop like process_beacon's
+        ok = await asyncio.to_thread(
+            self.scheme.verify_chain_batch, self.dist_key, msgs, sigs
+        )
         if not all(ok):
             bad = [batch[i].round for i, v in enumerate(ok) if not v]
             raise ValueError(f"invalid signatures at rounds {bad}")
