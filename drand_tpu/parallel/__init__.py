@@ -1,0 +1,28 @@
+"""Multi-chip sharding for the batch crypto kernels.
+
+The reference scales by replicating protocol work across *nodes* (t-of-n
+threshold parallelism, /root/reference/beacon/beacon.go:473-488) and has
+no intra-node parallel compute at all.  The TPU framework's scaling axis
+is the device mesh: batches of independent pairing checks are sharded
+across chips (data parallel over the `chains` axis — the 256-chain /
+1M-round catch-up configs), and large Lagrange recoveries shard their
+points across chips with an `all_gather` combine (the 667-of-1000 MSM
+config).  All collectives ride ICI via `jax.shard_map`; nothing here
+ever falls back to host gathers.
+
+Used by `__graft_entry__.dryrun_multichip` (the driver contract) and by
+`tests/test_shard.py` on the virtual 8-device CPU mesh, so the sharded
+path is covered on every CI run.
+"""
+
+from drand_tpu.parallel.shard import (
+    device_mesh,
+    sharded_msm,
+    sharded_pairing_check,
+)
+
+__all__ = [
+    "device_mesh",
+    "sharded_msm",
+    "sharded_pairing_check",
+]

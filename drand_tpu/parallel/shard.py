@@ -1,0 +1,122 @@
+"""Mesh construction + sharded verification / MSM kernels.
+
+Replaces the reference's scale-out story (goroutine-per-RPC unicast mesh,
+/root/reference/net/client_grpc.go) for the *compute* plane: on TPU the
+batch axes are sharded over a `jax.sharding.Mesh` and XLA inserts the
+collectives.  The host-side gRPC protocol plane is unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from drand_tpu.ops import pairing
+from drand_tpu.ops.curve import (
+    F1,
+    F2,
+    FieldOps,
+    point_add,
+    point_identity,
+    scalar_mul,
+)
+
+CHAIN_AXIS = "chains"
+
+
+def device_mesh(n_devices: int, axis: str = CHAIN_AXIS) -> Mesh:
+    """1-D mesh over the first `n_devices` available devices.
+
+    Prefers the default backend's devices; falls back to the virtual CPU
+    pool (``--xla_force_host_platform_device_count``) when the default
+    backend is a single chip.
+    """
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        devices = jax.devices("cpu")
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices, have {len(devices)}"
+        )
+    return Mesh(np.asarray(devices[:n_devices]), axis_names=(axis,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading batch axis across the mesh."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+def sharded_pairing_check(mesh: Mesh):
+    """Data-parallel batched pairing product check over the mesh.
+
+    Returns a jitted ``(p1, q1, p2, q2) -> bool[B]`` with the batch axis
+    sharded across devices — the kernel for multi-chip chain catch-up
+    (reference: the sequential verify loop at
+    /root/reference/beacon/beacon.go:557-601).
+    Batch size must be a multiple of the mesh size.
+    """
+    shard = batch_sharding(mesh)
+    return jax.jit(
+        pairing.pairing_product_check,
+        in_shardings=(shard, shard, shard, shard),
+        out_shardings=shard,
+    )
+
+
+def _sharded_msm(points, bits, *, mesh: Mesh, F: FieldOps):
+    axis = mesh.axis_names[0]
+
+    def local(points, bits):
+        prods = scalar_mul(points, bits, F)
+        acc = prods[0]
+        for i in range(1, prods.shape[0]):
+            acc = point_add(acc, prods[i], F)
+        gathered = jax.lax.all_gather(acc, axis)  # (n_dev, 3, ...)
+        out = gathered[0]
+        for i in range(1, gathered.shape[0]):
+            out = point_add(out, gathered[i], F)
+        return out
+
+    # check_vma=False: after all_gather every device holds the same sum,
+    # but the varying-axis checker cannot prove replication of a value
+    # computed from gathered shards.
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(mesh.axis_names[0]), P(mesh.axis_names[0])),
+        out_specs=P(),
+        check_vma=False,
+    )(points, bits)
+
+
+def sharded_msm(mesh: Mesh, points, bits, F: FieldOps = F2):
+    """sum_i bits_i * points_i with points sharded across the mesh.
+
+    points: (B, 3, *field_shape), bits: (B, 256) MSB-first; B is padded
+    up to a multiple of the mesh size with identity points (scalar 0), so
+    any committee size t works on any mesh.  Each device computes a local
+    partial group sum; the partials are combined via `all_gather` + tree
+    add on every device (tensor-parallel Lagrange recovery — reference:
+    kyber `share.RecoverCommit` consumed at
+    /root/reference/beacon/beacon.go:488).
+    """
+    n = mesh.devices.size
+    b = points.shape[0]
+    rem = (-b) % n
+    if rem:
+        pad_pts = jnp.broadcast_to(
+            point_identity(F), (rem, *points.shape[1:])
+        )
+        points = jnp.concatenate([points, pad_pts], axis=0)
+        bits = jnp.concatenate(
+            [bits, jnp.zeros((rem, bits.shape[1]), bits.dtype)], axis=0
+        )
+    shard = batch_sharding(mesh)
+    points = jax.device_put(points, shard)
+    bits = jax.device_put(bits, shard)
+    fn = jax.jit(partial(_sharded_msm, mesh=mesh, F=F))
+    return fn(points, bits)
