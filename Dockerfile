@@ -1,0 +1,31 @@
+# drand_tpu node image (reference: /root/reference/Dockerfile).
+#
+# CPU-only by default; on a TPU VM swap the jax pin for the libtpu
+# wheel (pip install 'jax[tpu]' -f
+# https://storage.googleapis.com/jax-releases/libtpu_releases.html)
+# and the daemon's `--backend auto` picks the device kernels up.
+
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ curl \
+    && rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir \
+    "jax[cpu]" \
+    grpcio \
+    protobuf \
+    aiohttp \
+    cryptography \
+    numpy
+
+WORKDIR /opt/drand_tpu
+COPY drand_tpu/ drand_tpu/
+COPY README.md .
+
+# public gRPC port / REST gateway / localhost control
+EXPOSE 8080 8081
+VOLUME /data
+
+ENTRYPOINT ["python", "-m", "drand_tpu.cli", "--folder", "/data"]
+CMD ["start", "--listen", "0.0.0.0:8080", "--rest-port", "8081"]

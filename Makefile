@@ -1,0 +1,31 @@
+# drand_tpu build/test targets (reference Makefile:6-13 equivalents).
+
+PY ?= python
+
+.PHONY: test test-slow bench bench-suite integration demo clean
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+test-slow:
+	$(PY) -m pytest tests/ -x -q -m "slow or not slow"
+
+bench:
+	$(PY) bench.py
+
+bench-suite:
+	$(PY) bench_suite.py
+
+# 5-node subprocess network with REST checks (reference
+# test/test-integration/run_local.sh)
+integration:
+	$(PY) deploy/integration.py
+
+# full lifecycle scenario: DKG, kill/restart, reshare
+# (reference demo/main.go via make test-integration)
+demo:
+	$(PY) demo/main.py
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} +
+	rm -rf .pytest_cache

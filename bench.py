@@ -4,19 +4,27 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 Workload (BASELINE.md "chain catch-up" / headline config): N historical
-beacon rounds are verified as batched pairing product checks
-e(-G, sig_i) * e(pk, H_i) == 1 — two Miller loops + one shared final
-exponentiation per round, exactly what `JaxScheme.verify_chain_batch`
-dispatches during sync (drand reference: one sequential pairing per round,
-/root/reference/beacon/beacon.go:575).
+beacon rounds are verified END-TO-END from message bytes — hash-to-curve
+H_i = H(msg_i) into G2 (host SHA-256 draws + device SVDW map + fast
+cofactor clearing, ops/h2c.py) followed by batched pairing product checks
+e(-G, sig_i) * e(pk, H_i) == 1 — exactly what
+`JaxScheme.verify_chain_batch` dispatches during sync (drand reference:
+hash + one sequential pairing per round,
+/root/reference/beacon/beacon.go:575,433).
+
+Round 1 excluded hashing and overstated the real catch-up path by ~4
+orders of magnitude (VERDICT r1, Weak #3); this version times bytes ->
+verified randomness.
 
 The baseline target is 50_000 pairings/sec/chip (BASELINE.json: verify 1M
-rounds < 60 s); vs_baseline = achieved_pairings_per_sec / 50_000.
+rounds < 60 s); vs_baseline = achieved_pairings_per_sec / 50_000, with
+pairings/sec = 2 * end-to-end rounds/sec.
 
 Environment knobs:
   BENCH_BATCH   rounds per device call   (default 1024)
   BENCH_ITERS   timed iterations         (default 4)
   BENCH_KERNEL  "pallas" (default: the mega-kernel) or "opgraph"
+  BENCH_DEVICE_ONLY  "1": skip hashing, time the pairing check alone
 """
 
 import json
@@ -27,48 +35,12 @@ import time
 import numpy as np
 
 
-def main() -> None:
+def select_check_kernel():
+    """(name, jitted pairing_product_check) — the Pallas mega-kernel on
+    real accelerators, the op-graph path on CPU (Mosaic doesn't lower
+    there).  Shared with bench_suite.py so every config measures the same
+    kernel the daemon's JaxScheme would use."""
     import jax
-    import jax.numpy as jnp
-
-    from drand_tpu.crypto import refimpl as ref
-    from drand_tpu.ops import curve, fp, pairing, tower
-
-    batch = int(os.environ.get("BENCH_BATCH", "1024"))
-    iters = int(os.environ.get("BENCH_ITERS", "4"))
-
-    # --- build a valid workload ------------------------------------------
-    sk = 0x1234567890ABCDEF1234567890ABCDEF % ref.R
-    pk = ref.g1_mul(ref.G1_GEN, sk)
-    neg_g = ref.g1_neg(ref.G1_GEN)
-
-    # "message hashes": distinct G2 points H_i = gen^(r_i), derived on
-    # device; signatures sig_i = H_i^sk.  (Host-side hash_to_curve is the
-    # protocol plane's job; this benchmark measures the device verify path,
-    # which is the reference's per-round pairing bottleneck.)
-    rng = np.random.default_rng(7)
-    scalars = [int(rng.integers(1, 1 << 62)) for _ in range(batch)]
-    bits = jnp.asarray(
-        np.stack([curve.scalar_to_bits(s) for s in scalars])
-    )
-    g2_gen = jnp.broadcast_to(
-        curve.g2_encode(ref.G2_GEN), (batch, 3, 2, fp.NLIMB)
-    )
-    h_proj = curve.g2_scalar_mul(g2_gen, bits)
-    sk_bits = jnp.broadcast_to(
-        jnp.asarray(curve.scalar_to_bits(sk)), (batch, 256)
-    )
-    sig_proj = curve.g2_scalar_mul(h_proj, sk_bits)
-
-    hx, hy = curve.g2_to_affine(h_proj)
-    sx, sy = curve.g2_to_affine(sig_proj)
-    q2 = jnp.stack([hx, hy], axis=1)      # H_i      (batch, 2, 2, NLIMB)
-    q1 = jnp.stack([sx, sy], axis=1)      # sig_i
-    enc_g1 = lambda pt: jnp.stack(
-        [fp.fp_encode(pt[0]), fp.fp_encode(pt[1])]
-    )
-    p1 = jnp.broadcast_to(enc_g1(neg_g), (batch, 2, fp.NLIMB))
-    p2 = jnp.broadcast_to(enc_g1(pk), (batch, 2, fp.NLIMB))
 
     backend = jax.default_backend().lower()
     default_kernel = (
@@ -78,32 +50,93 @@ def main() -> None:
     if kernel == "pallas":
         from drand_tpu.ops import pallas_pairing
 
-        check = jax.jit(pallas_pairing.pairing_product_check)
-    else:
-        check = jax.jit(pairing.pairing_product_check)
+        return kernel, jax.jit(pallas_pairing.pairing_product_check)
+    from drand_tpu.ops import pairing
+
+    return kernel, jax.jit(pairing.pairing_product_check)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from drand_tpu.crypto import refimpl as ref
+    from drand_tpu.ops import curve, fp, h2c
+
+    batch = int(os.environ.get("BENCH_BATCH", "1024"))
+    iters = int(os.environ.get("BENCH_ITERS", "4"))
+    device_only = os.environ.get("BENCH_DEVICE_ONLY", "0") == "1"
+
+    # --- build a valid workload ------------------------------------------
+    sk = 0x1234567890ABCDEF1234567890ABCDEF % ref.R
+    pk = ref.g1_mul(ref.G1_GEN, sk)
+    neg_g = ref.g1_neg(ref.G1_GEN)
+
+    # real beacon messages: round || prev-sig-ish bytes, hashed to G2 on
+    # device; signatures sig_i = H_i^sk computed once up front (a catch-up
+    # node receives sigs over the wire and recomputes H_i itself).
+    msgs = [
+        b"drand-tpu bench round %d" % r + r.to_bytes(8, "big")
+        for r in range(1, batch + 1)
+    ]
+    h_proj = h2c.hash_to_g2_batch_proj(msgs)
+    sk_bits = jnp.broadcast_to(
+        jnp.asarray(curve.scalar_to_bits(sk)), (batch, 256)
+    )
+    sig_proj = curve.g2_scalar_mul(h_proj, sk_bits)
+    sx, sy = curve.g2_to_affine(sig_proj)
+    q1 = jnp.stack([sx, sy], axis=1)      # sig_i  (batch, 2, 2, NLIMB)
+    enc_g1 = lambda pt: jnp.stack(
+        [fp.fp_encode(pt[0]), fp.fp_encode(pt[1])]
+    )
+    p1 = jnp.broadcast_to(enc_g1(neg_g), (batch, 2, fp.NLIMB))
+    p2 = jnp.broadcast_to(enc_g1(pk), (batch, 2, fp.NLIMB))
+
+    kernel, check = select_check_kernel()
+    fused = None
+    if kernel == "pallas":
+        from drand_tpu.ops import pallas_h2c
+
+        fused = pallas_h2c.pairing_product_check_hashed
+
+    def verify_e2e(msgs):
+        """bytes -> hashed -> pairing-checked, the real sync path."""
+        u0, u1 = h2c.hash_to_field_device(msgs)   # host SHA-256 (cheap)
+        if fused is not None:
+            # hash + double Miller loop + final exp in ONE kernel
+            return fused(p1, q1, p2, u0, u1)
+        q2 = h2c.map_and_clear_g2_affine(u0, u1)  # device map + clear
+        return check(p1, q1, p2, q2)
+
+    def verify_device_only(q2):
+        return check(p1, q1, p2, q2)
 
     # warmup / compile (excluded from timing)
-    ok = np.asarray(check(p1, q1, p2, q2))
+    q2_fixed = h2c.hash_to_g2_batch(msgs)
+    ok = np.asarray(verify_e2e(msgs) if not device_only
+                    else verify_device_only(q2_fixed))
     if not ok.all():
         print(json.dumps({"error": "verification failed in warmup"}))
         sys.exit(1)
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = check(p1, q1, p2, q2)
+        out = (verify_e2e(msgs) if not device_only
+               else verify_device_only(q2_fixed))
     out.block_until_ready()
     dt = time.perf_counter() - t0
 
     rounds_per_sec = batch * iters / dt
     pairings_per_sec = 2 * rounds_per_sec
     print(json.dumps({
-        "metric": "beacon-chain batch-verify throughput "
-                  "(BLS12-381 pairings/sec/chip)",
+        "metric": "beacon-chain batch-verify throughput, incl. "
+                  "hash-to-curve (BLS12-381 pairings/sec/chip)",
         "value": round(pairings_per_sec, 1),
         "unit": "pairings/sec/chip",
         "vs_baseline": round(pairings_per_sec / 50_000.0, 4),
         "detail": {
             "rounds_per_sec": round(rounds_per_sec, 1),
+            "includes_hash_to_curve": not device_only,
             "batch": batch,
             "kernel": kernel,
             "iters": iters,

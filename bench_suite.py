@@ -72,21 +72,21 @@ def ref_s_partials(ref_s, shares, msg):
 
 
 def _chain_args(batch: int):
+    """Real workload: messages hashed to G2 on device (ops/h2c.py),
+    signatures as device scalar mults of the hashes."""
     import jax.numpy as jnp
 
     from drand_tpu.crypto import refimpl as ref
-    from drand_tpu.ops import curve, fp
+    from drand_tpu.ops import curve, fp, h2c
 
     sk = 0x1234567890ABCDEF1234567890ABCDEF % ref.R
     pk = ref.g1_mul(ref.G1_GEN, sk)
     neg_g = ref.g1_neg(ref.G1_GEN)
-    rng = np.random.default_rng(7)
-    scalars = [int(rng.integers(1, 1 << 62)) for _ in range(batch)]
-    bits = jnp.asarray(np.stack([curve.scalar_to_bits(s) for s in scalars]))
-    g2 = jnp.broadcast_to(
-        curve.g2_encode(ref.G2_GEN), (batch, 3, 2, fp.NLIMB)
-    )
-    h = curve.g2_scalar_mul(g2, bits)
+    msgs = [
+        b"bench-suite round %d" % r + r.to_bytes(8, "big")
+        for r in range(1, batch + 1)
+    ]
+    h = h2c.hash_to_g2_batch_proj(msgs)
     skb = jnp.broadcast_to(
         jnp.asarray(curve.scalar_to_bits(sk)), (batch, 256)
     )
@@ -101,28 +101,46 @@ def _chain_args(batch: int):
 
     p1 = jnp.broadcast_to(enc_g1(neg_g), (batch, 2, fp.NLIMB))
     p2 = jnp.broadcast_to(enc_g1(pk), (batch, 2, fp.NLIMB))
-    return p1, aff(sig), p2, aff(h)
+    return msgs, p1, aff(sig), p2, aff(h)
 
 
 def bench_chain(n_rounds: int, batch: int) -> None:
-    import jax
+    """End-to-end catch-up: bytes -> H(m) on device -> pairing check
+    (same kernel selection as bench.py / the daemon's JaxScheme: the
+    FUSED hash+check kernel on the Pallas path)."""
+    from bench import select_check_kernel
+    from drand_tpu.ops import h2c
 
-    from drand_tpu.ops import pairing
+    msgs, p1, q1, p2, _ = _chain_args(batch)
+    kernel, fn = select_check_kernel()
+    fused = None
+    if kernel == "pallas":
+        from drand_tpu.ops import pallas_h2c
 
-    p1, q1, p2, q2 = _chain_args(batch)
-    fn = jax.jit(pairing.pairing_product_check)
-    ok = np.asarray(fn(p1, q1, p2, q2))
+        fused = pallas_h2c.pairing_product_check_hashed
+
+    def step():
+        u0, u1 = h2c.hash_to_field_device(msgs)
+        if fused is not None:
+            return fused(p1, q1, p2, u0, u1)
+        q2 = h2c.map_and_clear_g2_affine(u0, u1)
+        return fn(p1, q1, p2, q2)
+
+    ok = np.asarray(step())
     assert ok.all(), "warmup verification failed"
     iters = max(1, n_rounds // batch)
     t0 = time.perf_counter()
     for _ in range(iters):
-        r = fn(p1, q1, p2, q2)
+        r = step()
     np.asarray(r)
     dt = time.perf_counter() - t0
+    label = f"chain-{n_rounds // 1000}k" if n_rounds % 1000 == 0 \
+        else f"chain-{n_rounds}"
     _emit(
-        "chain-10k", dt, iters * batch, "rounds/sec",
+        label, dt, iters * batch, "rounds/sec",
         {"pairings_per_sec": round(2 * iters * batch / dt, 1),
-         "batch": batch},
+         "batch": batch, "kernel": kernel,
+         "includes_hash_to_curve": True},
     )
 
 
@@ -159,7 +177,6 @@ def _committee(t: int, n: int, name: str) -> None:
 def bench_256chains(batch_per_chain: int = 8) -> None:
     """256 independent chains sharded across the device mesh."""
     import jax
-    import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from drand_tpu.ops import pairing
@@ -172,7 +189,7 @@ def bench_256chains(batch_per_chain: int = 8) -> None:
     shard = NamedSharding(mesh, P("chains"))
 
     chains = 256
-    p1, q1, p2, q2 = _chain_args(chains)
+    _, p1, q1, p2, q2 = _chain_args(chains)
     args = [jax.device_put(x, shard) for x in (p1, q1, p2, q2)]
     fn = jax.jit(
         pairing.pairing_product_check,

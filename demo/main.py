@@ -7,7 +7,7 @@ restart the whole network, then reshare to a new group (one member
 retires, one joins) and confirm the chain continues under the same
 collective key.
 
-Run:  python demo/main.py [--nodes 5] [--period 20] [--keep]
+Run:  python demo/main.py [--nodes 5] [--period 30] [--keep]
 """
 
 from __future__ import annotations
@@ -122,7 +122,7 @@ def scenario(n: int, period: int, base: Path) -> None:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=5)
-    ap.add_argument("--period", type=int, default=20)
+    ap.add_argument("--period", type=int, default=30)
     ap.add_argument("--keep", action="store_true",
                     help="keep the working directory")
     ap.add_argument("--workdir", default=None)

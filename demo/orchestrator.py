@@ -103,8 +103,10 @@ class Node:
     def start(self) -> None:
         assert self.proc is None or self.proc.poll() is not None
         args = [sys.executable, "-m", "drand_tpu.cli",
-                "--folder", str(self.folder), "--control", str(self.ctrl),
-                "start"]
+                "--folder", str(self.folder), "--control", str(self.ctrl)]
+        if os.environ.get("DRAND_TPU_VERBOSE"):
+            args.append("--verbose")
+        args.append("start")
         if self.rest_port:
             args += ["--rest-port", str(self.rest_port)]
         logfh = open(self.log, "a")
@@ -195,13 +197,15 @@ class Orchestrator:
     def run_dkg(self, leader: Node, members: List[Node],
                 timeout: float = 300.0) -> str:
         """Followers first, leader last (reference control.go:20)."""
+        # generous in-protocol DKG timeout: schnorr-authenticated
+        # deals/responses cost real CPU on a shared-core host
         waits = [
-            m.cli_async("share", str(self.group_file))
+            m.cli_async("share", str(self.group_file), "--timeout", "240")
             for m in members if m is not leader
         ]
         time.sleep(2)
         lead = leader.cli("share", str(self.group_file), "--leader",
-                          timeout=timeout)
+                          "--timeout", "240", timeout=timeout)
         assert "distributed key:" in lead.stdout, lead.stdout
         self.dist_key_hex = lead.stdout.split("distributed key:")[1].strip()
         for p in waits:
@@ -221,11 +225,12 @@ class Orchestrator:
                 continue
             waits.append(m.cli_async(
                 "share", str(new_group_file), "--reshare",
-                "--from-group", str(old_group_file),
+                "--from-group", str(old_group_file), "--timeout", "240",
             ))
         time.sleep(2)
         leader.cli("share", str(new_group_file), "--leader", "--reshare",
-                   "--from-group", str(old_group_file), timeout=timeout)
+                   "--from-group", str(old_group_file),
+                   "--timeout", "240", timeout=timeout)
         for p in waits:
             out, _ = p.communicate(timeout=timeout)
             if p.returncode != 0:

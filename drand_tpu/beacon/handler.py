@@ -23,7 +23,6 @@ drive rounds deterministically, mirroring the reference's clockwork usage.
 from __future__ import annotations
 
 import asyncio
-import logging
 import random
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Callable, Dict, List, Optional
@@ -43,8 +42,9 @@ from drand_tpu.crypto import tbls
 from drand_tpu.key import Group, Identity, Share
 from drand_tpu.utils import metrics
 from drand_tpu.utils.clock import Clock
+from drand_tpu.utils.logging import get_logger
 
-log = logging.getLogger("drand_tpu.beacon")
+log = get_logger("beacon")
 
 _rounds_total = metrics.counter(
     "drand_beacon_rounds_total", "beacon rounds stored by this node"
@@ -142,6 +142,7 @@ class BeaconHandler:
         if idx is None:
             raise ValueError("this node is not part of the group")
         self.index = idx
+        self.log = log.bind(node=idx, addr=cfg.public.address)
         self.pub_poly = cfg.share.pub_poly()
         self.dist_key = cfg.share.public().key()
         self.manager = RoundManager(self.scheme.index_of)
@@ -246,7 +247,7 @@ class BeaconHandler:
             raise
         except Exception:
             _rounds_failed.inc()  # recovery/verification failure
-            log.exception("round %s failed on node %s", round, self.index)
+            self.log.exception("round failed", round=round)
 
     async def _run_round_inner(self, round: int) -> None:
         t_start = asyncio.get_running_loop().time()
@@ -255,8 +256,16 @@ class BeaconHandler:
             return
         prev_round, prev_sig = head.round, head.signature
         msg = beacon_message(prev_sig, prev_round, round)
-        own = self.scheme.partial_sign(self.cfg.share.share, msg)
-        queue = self.manager.new_round(round)
+        # sign OFF the event loop (reference: the round goroutine,
+        # beacon.go:433).  A synchronous sign blocks every ingest task
+        # for ~1s of crypto; on a loaded host the whole network then
+        # starves itself: each node's inbound partials only get CPU
+        # after the next tick's signs, so every round is abandoned with
+        # its partials still queued behind the loop.
+        own = await asyncio.to_thread(
+            self.scheme.partial_sign, self.cfg.share.share, msg
+        )
+        queue = self.manager.new_round(round, prev_round, prev_sig)
         self.manager.add_partial(round, own, prev_round, prev_sig)
         packet = BeaconPacket(
             from_address=self.cfg.public.address,
@@ -272,12 +281,9 @@ class BeaconHandler:
 
         partials: Dict[int, bytes] = {self.index: own}
         while len(partials) < self.group.threshold:
-            blob, p_prev_round, p_prev_sig = await queue.get()
-            if p_prev_round != prev_round or p_prev_sig != prev_sig:
-                # the signer is on a different chain link than us — its
-                # partial signs a different message and would poison the
-                # Lagrange recovery
-                continue
+            # the manager only queues partials matching our chain link
+            # (mismatches don't consume the signer's dedup slot)
+            blob, _, _ = await queue.get()
             partials[self.scheme.index_of(blob)] = blob
 
         sig = await asyncio.to_thread(
@@ -301,7 +307,7 @@ class BeaconHandler:
         _round_seconds.observe(
             asyncio.get_running_loop().time() - t_start
         )
-        log.debug("node %s stored round %s", self.index, round)
+        self.log.debug("round stored", round=round)
         if self._stop_at is not None and round >= self._stop_at:
             self._running = False
             self._stopped.set()
@@ -318,7 +324,7 @@ class BeaconHandler:
         try:
             await self.client.new_beacon(node, packet)
         except Exception as exc:  # peer down — the threshold absorbs it
-            log.debug("broadcast to %s failed: %s", node.address, exc)
+            self.log.debug("broadcast failed", to=node.address, err=exc)
 
     # -- inbound RPCs ------------------------------------------------------
 
@@ -383,7 +389,7 @@ class BeaconHandler:
             try:
                 await self._sync_from(peer)
             except Exception as exc:
-                log.debug("sync from %s failed: %s", peer.address, exc)
+                self.log.debug("sync failed", peer=peer.address, err=exc)
             head = self.store.last()
             now = self.clock.now()
             cur = current_round(now, self.group.period,

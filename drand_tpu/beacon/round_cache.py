@@ -25,14 +25,22 @@ class RoundManager:
         self._round: Optional[int] = None
         self._queue: Optional[asyncio.Queue] = None
         self._seen: set = set()
+        self._link: Optional[Tuple[int, bytes]] = None
         self._future: Dict[int, List[Tuple[bytes, int, bytes]]] = {}
         self._buffered = 0
 
-    def new_round(self, round: int) -> asyncio.Queue:
-        """Activate a round; flush any buffered partials for it."""
+    def new_round(self, round: int, prev_round: Optional[int] = None,
+                  prev_sig: Optional[bytes] = None) -> asyncio.Queue:
+        """Activate a round; flush any buffered partials for it.
+
+        When (prev_round, prev_sig) is given, only partials signing that
+        exact chain link are accepted."""
         self._round = round
         self._queue = asyncio.Queue()
         self._seen = set()
+        self._link = (
+            (prev_round, prev_sig) if prev_sig is not None else None
+        )
         for entry in self._future.pop(round, []):
             self._buffered -= 1
             self._offer(entry)
@@ -42,6 +50,12 @@ class RoundManager:
         return self._queue
 
     def _offer(self, entry: Tuple[bytes, int, bytes]) -> None:
+        if self._link is not None and (entry[1], entry[2]) != self._link:
+            # wrong chain link: the signer is desynced and its partial
+            # signs a different message.  Dropped WITHOUT consuming the
+            # signer's dedup slot, so a corrected partial re-sent after
+            # the peer resyncs can still count toward this round.
+            return
         idx = self._index_of(entry[0])
         if idx in self._seen:
             return

@@ -80,13 +80,18 @@ def cmd_group(args) -> int:
 
 
 def cmd_check_group(args) -> int:
-    from drand_tpu.net import GrpcClient
+    from drand_tpu.net import CertManager, GrpcClient
 
     with open(args.group, "rb") as fh:
         group = Group.from_dict(tomllib.load(fh))
 
+    certs = CertManager()
+    n = _load_certs_dir(certs, getattr(args, "certs_dir", None))
+    if n:
+        print(f"trusting {n} certificate(s) from {args.certs_dir}")
+
     async def probe() -> int:
-        client = GrpcClient()
+        client = GrpcClient(certs)
         failures = 0
         for node in group.nodes:
             try:
@@ -103,6 +108,23 @@ def cmd_check_group(args) -> int:
     return 1 if bad else 0
 
 
+def _load_certs_dir(cert_manager, certs_dir) -> int:
+    """Seed the trust pool with every PEM in a directory (reference
+    CertManager, net/certs.go:14-43)."""
+    n = 0
+    if certs_dir:
+        d = Path(certs_dir)
+        if not d.is_dir():
+            raise SystemExit(
+                f"--certs-dir {certs_dir}: not a directory"
+            )
+        for p in sorted(d.iterdir()):
+            if p.suffix.lower() in (".pem", ".crt", ".cert"):
+                cert_manager.add_file(str(p))
+                n += 1
+    return n
+
+
 def cmd_start(args) -> int:
     from drand_tpu.core import Config, Drand
     from drand_tpu.crypto import tbls
@@ -110,13 +132,29 @@ def cmd_start(args) -> int:
     async def run():
         store = _store(args)
         pair = store.load_key_pair()
+        tls_cert = tls_key = None
+        if args.tls_cert or args.tls_key:
+            if not (args.tls_cert and args.tls_key):
+                raise SystemExit(
+                    "--tls-cert and --tls-key must be given together"
+                )
+            tls_cert = Path(args.tls_cert).read_bytes()
+            tls_key = Path(args.tls_key).read_bytes()
         cfg = Config(
             base_folder=args.folder,
             listen_addr=args.listen or pair.public.address,
             control_port=args.control,
             rest_port=args.rest_port,
             scheme=tbls.default_scheme(args.backend),
+            tls_cert=tls_cert,
+            tls_key=tls_key,
+            insecure=tls_cert is None,
         )
+        n = _load_certs_dir(cfg.cert_manager, args.certs_dir)
+        if n:
+            print(f"trusting {n} certificate(s) from {args.certs_dir}")
+        if tls_cert is not None:
+            print("TLS enabled (gRPC + REST)")
         try:
             store.load_group()
             daemon = await Drand.load(cfg, pair)
@@ -283,6 +321,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="base config folder")
     p.add_argument("--control", type=int, default=DEFAULT_CONTROL,
                    help="control port")
+    p.add_argument("--verbose", action="store_const", const=10,
+                   dest="log_level", help="debug-level logfmt output")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     g = sub.add_parser("generate-keypair")
@@ -300,12 +340,31 @@ def build_parser() -> argparse.ArgumentParser:
 
     g = sub.add_parser("check-group")
     g.add_argument("group")
+    g.add_argument("--certs-dir",
+                   help="directory of PEM roots for probing TLS nodes")
     g.set_defaults(fn=cmd_check_group)
 
     g = sub.add_parser("start")
     g.add_argument("--listen")
     g.add_argument("--rest-port", type=int)
-    g.add_argument("--backend", choices=["ref", "jax"], default="ref")
+    g.add_argument("--tls-cert",
+                   help="PEM certificate; enables TLS on gRPC + REST")
+    g.add_argument("--tls-key", help="PEM private key")
+    g.add_argument("--certs-dir",
+                   help="directory of PEM roots to trust when dialing "
+                        "TLS peers")
+    env_backend = os.environ.get("DRAND_TPU_BACKEND", "auto")
+    if env_backend not in ("auto", "ref", "jax"):
+        raise SystemExit(
+            f"DRAND_TPU_BACKEND={env_backend!r}: must be auto, ref or jax"
+        )
+    g.add_argument(
+        "--backend", choices=["auto", "ref", "jax"],
+        default=env_backend,
+        help="crypto backend: auto = device kernels when an accelerator "
+             "is present (default; DRAND_TPU_BACKEND overrides), "
+             "ref = pure-Python oracle",
+    )
     g.set_defaults(fn=cmd_start)
 
     g = sub.add_parser("stop")
@@ -342,7 +401,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    from drand_tpu.utils.logging import setup as setup_logging
+
     args = build_parser().parse_args(argv)
+    setup_logging(getattr(args, "log_level", None) or 20)  # INFO
     return args.fn(args)
 
 

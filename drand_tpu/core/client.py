@@ -60,7 +60,14 @@ class DrandClient:
         return self._verify(await self._net.public_rand(peer, 0))
 
     async def public(self, peer: Identity, round: int) -> Beacon:
-        return self._verify(await self._net.public_rand(peer, round))
+        b = self._verify(await self._net.public_rand(peer, round))
+        # a validly-signed but *older* beacon must not satisfy a
+        # specific-round request (a misbehaving node could replay one)
+        if round != 0 and b.round != round:
+            raise VerificationError(
+                f"node answered round {b.round} for requested {round}"
+            )
+        return b
 
     async def private(self, peer: Identity) -> bytes:
         """Private randomness: send an ECIES-wrapped ephemeral key, get
@@ -86,7 +93,10 @@ class RestClient:
     unverified randomness as the gRPC client."""
 
     def __init__(self, dist_key, base_url: str,
-                 scheme: Optional[tbls.Scheme] = None):
+                 scheme: Optional[tbls.Scheme] = None, ssl=None):
+        #: ssl.SSLContext trusting the node's cert (https base_url), or
+        #: None for plain http / system roots
+        self._ssl = ssl
         self.dist_key = dist_key
         self.base_url = base_url.rstrip("/")
         self.scheme = scheme or tbls.default_scheme()
@@ -123,7 +133,8 @@ class RestClient:
 
     async def _get_json(self, path: str) -> dict:
         http = await self._http()
-        async with http.get(f"{self.base_url}{path}") as resp:
+        async with http.get(f"{self.base_url}{path}",
+                            ssl=self._ssl) as resp:
             if resp.status != 200:
                 raise FetchError(f"GET {path}: HTTP {resp.status}")
             return await resp.json()
@@ -132,9 +143,14 @@ class RestClient:
         return self._verify_json(await self._get_json("/api/public"))
 
     async def public(self, round: int) -> Beacon:
-        return self._verify_json(
+        b = self._verify_json(
             await self._get_json(f"/api/public/{round}")
         )
+        if round != 0 and b.round != round:
+            raise VerificationError(
+                f"node answered round {b.round} for requested {round}"
+            )
+        return b
 
     async def private(self, peer_key) -> bytes:
         """Private randomness over REST (POST /api/private)."""
@@ -145,6 +161,7 @@ class RestClient:
         async with http.post(
             f"{self.base_url}/api/private",
             json={"request": request.hex()},
+            ssl=self._ssl,
         ) as resp:
             if resp.status != 200:
                 raise FetchError(f"HTTP {resp.status}")

@@ -17,7 +17,6 @@ Mirrors /root/reference/core/drand.go + drand_control.go + drand_public.go:
 from __future__ import annotations
 
 import asyncio
-import logging
 import os
 import secrets
 from dataclasses import dataclass, field
@@ -56,7 +55,9 @@ from drand_tpu.net import (
 from drand_tpu.utils import toml_dumps
 from drand_tpu.utils.clock import Clock
 
-log = logging.getLogger("drand_tpu.core")
+from drand_tpu.utils.logging import get_logger
+
+log = get_logger("core")
 
 MIN_GROUP_SIZE = 4          # reference core/drand_control.go:356
 DEFAULT_CONTROL_PORT = 8888  # reference net/control.go:21
@@ -73,6 +74,7 @@ class Config:
     public_addr: Optional[str] = None    # address peers dial (default: listen)
     control_port: int = DEFAULT_CONTROL_PORT
     rest_port: Optional[int] = None      # REST gateway (None = disabled)
+    rest_host: str = "0.0.0.0"           # REST bind host
     tls_cert: Optional[bytes] = None     # PEM (with tls_key enables TLS)
     tls_key: Optional[bytes] = None
     cert_manager: CertManager = field(default_factory=CertManager)
@@ -152,10 +154,44 @@ class Drand:
         if self.cfg.rest_port is not None:
             from drand_tpu.net.rest import build_rest_app, start_rest
 
+            ssl_ctx = None
+            if tls is not None:
+                ssl_ctx = self._rest_ssl_context(*tls)
             runner = await start_rest(
-                build_rest_app(self), self.cfg.rest_port
+                build_rest_app(self), self.cfg.rest_port,
+                host=self.cfg.rest_host, ssl_context=ssl_ctx,
             )
             self._servers.append(runner)
+
+    def _rest_ssl_context(self, cert_pem: bytes, key_pem: bytes):
+        """ssl.SSLContext from PEM bytes (the ssl module only loads from
+        files, so the material lands in the daemon folder, 0600)."""
+        import ssl
+        import tempfile
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        if self.cfg.in_memory:
+            tmpdir = tempfile.mkdtemp(prefix="drand-tls-")
+            base = tmpdir
+        else:
+            tmpdir = None
+            base = os.path.expanduser(self.cfg.base_folder)
+        cpath = os.path.join(base, ".rest-cert.pem")
+        kpath = os.path.join(base, ".rest-key.pem")
+        for path, blob in ((cpath, cert_pem), (kpath, key_pem)):
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                         0o600)
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(blob)
+        try:
+            ctx.load_cert_chain(cpath, kpath)
+        finally:
+            if tmpdir is not None:
+                # in-memory daemons must not leave key material on disk
+                import shutil
+
+                shutil.rmtree(tmpdir, ignore_errors=True)
+        return ctx
 
     async def stop(self) -> None:
         if self.beacon is not None:
@@ -227,7 +263,10 @@ class Drand:
         self.key_store.save_group(group)
         self.key_store.save_share(share)
         self.key_store.save_dist_public(self.dist)
-        await self.start_beacon(catchup=False)
+        # a slow DKG can outlive the genesis window (small hosts, many
+        # daemons); join via catch-up instead of refusing to start
+        late = self.clock.now() > group.genesis_time + group.period
+        await self.start_beacon(catchup=late)
         return ref.g1_to_bytes(self.dist.key()).hex()
 
     async def init_reshare(self, new_group_toml: str, is_leader: bool,
@@ -381,6 +420,11 @@ class Drand:
              else self.beacon.store.get(round))
         if b is None:
             raise KeyError(f"no beacon for round {round}")
+        if round == 0 and b.round == 0:
+            # the genesis beacon's "signature" is the chain seed, not a
+            # BLS signature — serving it as "latest randomness" hands a
+            # verifying client bytes that can never verify
+            raise KeyError("no signed beacon yet (chain at genesis)")
         return b
 
     def subscribe_beacons(self) -> asyncio.Queue:
@@ -428,8 +472,8 @@ class Drand:
             try:
                 await self.beacon.process_beacon(packet)
             except Exception as exc:
-                log.debug("dropping partial from %s: %s",
-                          packet.from_address, exc)
+                log.debug("dropping partial", frm=packet.from_address,
+                          err=exc)
 
         asyncio.create_task(_ingest())
 

@@ -192,10 +192,13 @@ class JaxScheme(Scheme):
         import jax
         import jax.numpy as jnp
 
-        from drand_tpu.ops import curve, msm, pairing  # noqa
+        from drand_tpu.ops import curve, fp, h2c, msm, pairing, tower  # noqa
 
         self._curve, self._msm, self._pairing = curve, msm, pairing
+        self._h2c = h2c
         self._jnp = jnp
+        self._nlimb = fp.NLIMB
+        self._one2 = tower.fp2_encode((1, 0))  # projective Z constant
         # pairing backend: the Pallas mega-kernel on real accelerators,
         # the op-graph path on CPU (Pallas-TPU doesn't lower there).
         # Override with DRAND_TPU_PAIRING=opgraph|pallas.
@@ -208,41 +211,66 @@ class JaxScheme(Scheme):
             choice == "auto" and is_tpu
         )
         if use_pallas:
-            from drand_tpu.ops import pallas_pairing
+            from drand_tpu.ops import pallas_h2c, pallas_pairing
 
             self._check = pallas_pairing.pairing_product_check
+            # end-to-end kernel: H(m) computed in-kernel, straight into
+            # the Miller loops (one device op per verified batch)
+            self._check_hashed = pallas_h2c.pairing_product_check_hashed
+            self._hash_pallas = pallas_h2c.hash_to_g2
         else:
             self._check = pairing.pairing_product_check
+            self._check_hashed = None
+            self._hash_pallas = None
 
     # -- encode helpers ---------------------------------------------------
 
-    @staticmethod
-    def _bucket(n: int) -> int:
-        """Round a batch size up to a power of two (min 8) so XLA compiles
-        the pairing pipeline for O(log) distinct shapes, not one per size."""
+    def _bucket(self, n: int) -> int:
+        """Round a batch size up so XLA compiles the pairing pipeline for
+        few distinct shapes, not one per size.
+
+        Pallas backend: multiples of the kernel block (128) — every
+        batch <= 128 shares ONE compiled shape (a fresh Mosaic compile
+        costs tens of minutes on small hosts, so shape reuse matters
+        more than padded work; the kernel pads to the block anyway).
+        Op-graph backend: powers of two (min 8) — padded lanes cost real
+        FLOPs there, so tighter buckets win."""
+        if self._check_hashed is not None:
+            return ((n + 127) // 128) * 128
         b = 8
         while b < n:
             b *= 2
         return b
 
-    def _enc_g1(self, pt):
-        import drand_tpu.ops.fp as fp
+    def _hash_msgs(self, msgs):
+        """Batched device H(m), affine (B, 2, 2, L) — the Pallas kernel
+        when available (it pads any batch <= its block into ONE compile
+        shape; the op-graph path pays a fresh multi-minute XLA compile
+        per batch bucket), the op-graph path otherwise."""
+        if self._hash_pallas is not None:
+            # pad to the kernel block on the HOST (cheap SHA) so every
+            # batch <= 128 presents the same jit shape
+            n = len(msgs)
+            padded = list(msgs) + [msgs[0]] * ((-n) % 128)
+            u0, u1 = self._h2c.hash_to_field_device(padded)
+            return self._hash_pallas(u0, u1)[:n]
+        return self._h2c.hash_to_g2_batch(msgs)
 
-        return self._jnp.stack([fp.fp_encode(pt[0]), fp.fp_encode(pt[1])])
-
-    def _enc_g2(self, pt):
-        from drand_tpu.ops import tower
-
-        return self._jnp.stack(
-            [tower.fp2_encode(pt[0]), tower.fp2_encode(pt[1])]
+    def _hash_msgs_proj(self, msgs):
+        """Same, projective (B, 3, 2, L) for scalar-mult consumers."""
+        aff = self._hash_msgs(msgs)
+        one = self._jnp.broadcast_to(
+            self._one2, (len(msgs), 1, 2, self._nlimb)
         )
+        return self._jnp.concatenate([aff, one], axis=1)
 
     # -- single-op API (device scalar mult / single pairing check) -------
 
     def partial_sign(self, share: PriShare, msg: bytes) -> bytes:
-        h = hash_to_sig_group(msg)
         with _kernel_seconds["g2_sign"].time():
-            hq = self._curve.g2_encode(h)
+            # H(m) on device too (reference: Sign includes hash-to-curve,
+            # /root/reference/beacon/beacon.go:433)
+            hq = self._hash_msgs_proj([msg])[0]
             bits = self._jnp.asarray(
                 self._curve.scalar_to_bits(share.value)
             )
@@ -262,9 +290,7 @@ class JaxScheme(Scheme):
                 partials: Sequence[bytes], t: int, n: int) -> bytes:
         chosen = self._recover_indices(partials, t)
         lam = lagrange_basis_at_zero([i for i, _ in chosen])
-        pts = self._jnp.stack(
-            [self._curve.g2_encode(pt) for _, pt in chosen]
-        )
+        pts = self._curve.g2_encode_batch([pt for _, pt in chosen])
         bits = self._jnp.asarray(
             np.stack(
                 [self._curve.scalar_to_bits(lam[i]) for i, _ in chosen]
@@ -284,7 +310,6 @@ class JaxScheme(Scheme):
 
     def verify_partials_batch(self, pub: PubPoly, msg: bytes,
                               partials: Sequence[bytes]) -> List[bool]:
-        h = hash_to_sig_group(msg)
         neg_g = ref.g1_neg(ref.G1_GEN)
         sigs, pks, valid = [], [], []
         for blob in partials:
@@ -303,10 +328,15 @@ class JaxScheme(Scheme):
         nb = self._bucket(len(live))
         pad = [live[0]] * (nb - len(live))
         rows = live + pad
-        p1 = self._jnp.stack([self._enc_g1(neg_g)] * nb)
-        q1 = self._jnp.stack([self._enc_g2(sigs[i]) for i in rows])
-        p2 = self._jnp.stack([self._enc_g1(pks[i]) for i in rows])
-        q2 = self._jnp.stack([self._enc_g2(h)] * nb)
+        # batched encoders: one device dispatch per operand, not per row
+        p1 = self._jnp.broadcast_to(
+            self._curve.g1_affine_encode_batch([neg_g])[0],
+            (nb, 2, self._nlimb),
+        )
+        q1 = self._curve.g2_affine_encode_batch([sigs[i] for i in rows])
+        p2 = self._curve.g1_affine_encode_batch([pks[i] for i in rows])
+        h1 = self._hash_msgs([msg])             # (1, 2, 2, L) on device
+        q2 = self._jnp.broadcast_to(h1[0], (nb, *h1.shape[1:]))
         with _kernel_seconds["pairing_check"].time():
             ok = np.asarray(self._check(p1, q1, p2, q2))
         out = [False] * len(partials)
@@ -333,13 +363,20 @@ class JaxScheme(Scheme):
             return [False] * len(sigs)
         nb = self._bucket(len(live))
         rows = live + [live[0]] * (nb - len(live))
-        hs = {i: hash_to_sig_group(msgs[i]) for i in set(rows)}
-        p1 = self._jnp.stack([self._enc_g1(neg_g)] * nb)
-        q1 = self._jnp.stack([self._enc_g2(pts[i]) for i in rows])
-        p2 = self._jnp.stack([self._enc_g1(pub_key)] * nb)
-        q2 = self._jnp.stack([self._enc_g2(hs[i]) for i in rows])
+        ends = self._curve.g1_affine_encode_batch([neg_g, pub_key])
+        p1 = self._jnp.broadcast_to(ends[0], (nb, 2, self._nlimb))
+        q1 = self._curve.g2_affine_encode_batch([pts[i] for i in rows])
+        p2 = self._jnp.broadcast_to(ends[1], (nb, 2, self._nlimb))
+        # messages hashed on device, batched (round 1 paid 0.6 s of host
+        # Python per row here — the whole point of ops/h2c.py)
+        row_msgs = [msgs[i] for i in rows]
         with _kernel_seconds["pairing_check"].time():
-            ok = np.asarray(self._check(p1, q1, p2, q2))
+            if self._check_hashed is not None:
+                u0, u1 = self._h2c.hash_to_field_device(row_msgs)
+                ok = np.asarray(self._check_hashed(p1, q1, p2, u0, u1))
+            else:
+                q2 = self._h2c.hash_to_g2_batch(row_msgs)
+                ok = np.asarray(self._check(p1, q1, p2, q2))
         out = [False] * len(sigs)
         for j, i in enumerate(live):
             out[i] = bool(ok[j])
@@ -349,9 +386,34 @@ class JaxScheme(Scheme):
 _DEFAULT: Optional[Scheme] = None
 
 
+def _accelerator_present() -> bool:
+    """True when JAX's default backend is a real accelerator (the axon
+    tunnel reports itself as its own platform name)."""
+    try:
+        import jax
+
+        backend = jax.default_backend().lower()
+    except Exception:
+        return False
+    return "tpu" in backend or "gpu" in backend or backend == "axon"
+
+
 def default_scheme(backend: Optional[str] = None) -> Scheme:
-    """Process-wide scheme selection ('ref' or 'jax'); defaults to 'ref'."""
+    """Process-wide scheme selection.
+
+    'jax'  — device batched kernels;
+    'ref'  — pure-Python oracle;
+    'auto' — JaxScheme when an accelerator is present, RefScheme
+             otherwise (the reference always runs its native crypto
+             suite, /root/reference/key/curve.go:12 — a daemon booted on
+             a TPU host should use the device path with no flags).
+
+    Bare default (no argument, first call) stays 'ref': library users who
+    never asked for a device shouldn't pay a JAX initialization.
+    """
     global _DEFAULT
+    if backend == "auto":
+        backend = "jax" if _accelerator_present() else "ref"
     if backend is not None:
         _DEFAULT = JaxScheme() if backend == "jax" else RefScheme()
     elif _DEFAULT is None:

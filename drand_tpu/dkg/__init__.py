@@ -9,6 +9,7 @@ from drand_tpu.dkg.pedersen import (  # noqa: F401
     Deal,
     DistKeyGenerator,
     DKGError,
+    Justification,
     Response,
 )
 from drand_tpu.dkg.handler import DKGConfig, DKGHandler  # noqa: F401

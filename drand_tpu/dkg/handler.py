@@ -15,7 +15,6 @@ Mirrors /root/reference/dkg/dkg.go behavior:
 from __future__ import annotations
 
 import asyncio
-import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -23,12 +22,15 @@ from drand_tpu.dkg.pedersen import (
     Deal,
     DistKeyGenerator,
     DKGError,
+    Justification,
     Response,
 )
 from drand_tpu.key import Group, Identity, Pair, Share
 from drand_tpu.utils.clock import Clock
 
-log = logging.getLogger("drand_tpu.dkg")
+from drand_tpu.utils.logging import get_logger
+
+log = get_logger("dkg")
 
 DEFAULT_TIMEOUT = 60.0  # reference core/constants.go:34
 
@@ -68,6 +70,9 @@ class DKGHandler:
             old_threshold=old_group.threshold if old_group else None,
             old_dist_commits=old_commits,
             entropy=cfg.entropy,
+            # signatures are domain-separated by the group hash so a
+            # message from one DKG run cannot be replayed into another
+            session_id=cfg.new_group.hash(),
         )
         self._sent_deals = False
         self._done = False
@@ -135,7 +140,7 @@ class DKGHandler:
             try:
                 await self.net.send_dkg(peer, packet)
             except Exception as exc:
-                log.debug("dkg send to %s failed: %s", peer.address, exc)
+                log.debug("dkg send failed", to=peer.address, err=exc)
 
         asyncio.create_task(_go())
 
@@ -157,7 +162,7 @@ class DKGHandler:
             try:
                 resp = self.dkg.process_deal(deal)
             except DKGError as exc:
-                log.warning("bad deal: %s", exc)
+                log.warning("bad deal", err=exc)
                 return
             await self._broadcast_response(resp)
         elif "dkg_response" in packet:
@@ -166,9 +171,39 @@ class DKGHandler:
                     Response.from_dict(packet["dkg_response"])
                 )
             except DKGError as exc:
-                log.warning("bad response: %s", exc)
+                log.warning("bad response", err=exc)
+                return
+            # a complaint against OUR dealing: answer it publicly by
+            # revealing the disputed sub-share (kyber justification,
+            # vss.proto:60-69) so a false complaint cannot exclude us
+            await self._broadcast_justifications()
+            self._check_done()
+        elif "dkg_justification" in packet:
+            try:
+                self.dkg.process_justification(
+                    Justification.from_dict(packet["dkg_justification"])
+                )
+            except DKGError as exc:
+                log.warning("bad justification", err=exc)
                 return
             self._check_done()
+
+    async def _broadcast_justifications(self) -> None:
+        for complaint in self.dkg.pending_complaints():
+            just = self.dkg.justify(complaint)
+            log.info(
+                "justifying complaint",
+                verifier=complaint.verifier_index,
+                dealer=complaint.dealer_index,
+            )
+            # apply locally too (we don't receive our own broadcast):
+            # neutralizes the complaint in our own certification state
+            self.dkg.process_justification(just)
+            packet = {"dkg_justification": just.to_dict()}
+            for node in self._all_nodes():
+                if self._is_self(node):
+                    continue
+                await self._send(node, packet)
 
     # -- certification ----------------------------------------------------
 

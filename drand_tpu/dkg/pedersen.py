@@ -18,19 +18,26 @@ The math mirrors kyber `dkg/pedersen` as consumed by the reference
   and the beacon chain) is preserved across resharing;
 * final commitments: coefficient-wise  sum_{d in QUAL} w_d * C_{d,k}.
 
-Complaint handling is exclusion-based: a dealer that fails to reach t
-approvals is simply left out of QUAL (the reference's timeout path
-dkg/dkg.go:383-426 behaves the same for non-answering dealers; kyber's
-justification round-trip is not reproduced).
+Complaints trigger a justification round (kyber vss semantics,
+/root/reference/protobuf/crypto/vss/vss.proto:60-69, consumed at
+dkg/dkg.go:319-426): a complained-against dealer publishes the disputed
+plaintext sub-share; everyone re-verifies it against the dealer's
+commitments.  A valid justification neutralizes the complaint (the
+complainer adopts the now-public sub-share), so a lying verifier cannot
+knock an honest dealer out of QUAL; an invalid justification proves the
+dealer cheated and excludes it outright.  A dealer that never answers a
+complaint simply fails to reach certification, as in the reference's
+timeout path (dkg/dkg.go:383-426).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Dict, List, Optional, Sequence
 
 from drand_tpu.crypto import ecies
 from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto import schnorr
 from drand_tpu.crypto.poly import (
     PriPoly,
     PriShare,
@@ -45,13 +52,26 @@ class DKGError(Exception):
 
 @dataclass(frozen=True)
 class Deal:
+    """signature: Schnorr by the dealer's long-term key — unauthenticated
+    deals would let anyone induce complaints (and hence public sub-share
+    justifications) in a dealer's name (kyber signs its vss messages,
+    /root/reference/protobuf/crypto/vss/vss.proto)."""
+
     dealer_index: int
     recipient_index: int
     commits_bytes: tuple          # tuple of 48-byte G1 commitments
     encrypted_share: bytes
+    signature: bytes = b""
 
     def commits(self) -> List[tuple]:
         return [ref.g1_from_bytes(b) for b in self.commits_bytes]
+
+    def signed_payload(self, session_id: bytes) -> bytes:
+        return (b"drand-tpu-dkg-deal" + session_id
+                + self.dealer_index.to_bytes(4, "big")
+                + self.recipient_index.to_bytes(4, "big")
+                + b"".join(self.commits_bytes)
+                + self.encrypted_share)
 
     def to_dict(self) -> dict:
         return {
@@ -59,6 +79,7 @@ class Deal:
             "recipient_index": self.recipient_index,
             "commits": [b.hex() for b in self.commits_bytes],
             "encrypted_share": self.encrypted_share.hex(),
+            "signature": self.signature.hex(),
         }
 
     @classmethod
@@ -68,20 +89,33 @@ class Deal:
             recipient_index=int(d["recipient_index"]),
             commits_bytes=tuple(bytes.fromhex(h) for h in d["commits"]),
             encrypted_share=bytes.fromhex(d["encrypted_share"]),
+            signature=bytes.fromhex(d.get("signature", "")),
         )
 
 
 @dataclass(frozen=True)
 class Response:
+    """signature: Schnorr by the verifier — a forged complaint would
+    otherwise trick the dealer into publicly revealing the named
+    verifier's sub-share via the justification round."""
+
     dealer_index: int
     verifier_index: int
     approved: bool
+    signature: bytes = b""
+
+    def signed_payload(self, session_id: bytes) -> bytes:
+        return (b"drand-tpu-dkg-resp" + session_id
+                + self.dealer_index.to_bytes(4, "big")
+                + self.verifier_index.to_bytes(4, "big")
+                + (b"\x01" if self.approved else b"\x00"))
 
     def to_dict(self) -> dict:
         return {
             "dealer_index": self.dealer_index,
             "verifier_index": self.verifier_index,
             "approved": self.approved,
+            "signature": self.signature.hex(),
         }
 
     @classmethod
@@ -90,6 +124,53 @@ class Response:
             dealer_index=int(d["dealer_index"]),
             verifier_index=int(d["verifier_index"]),
             approved=bool(d["approved"]),
+            signature=bytes.fromhex(d.get("signature", "")),
+        )
+
+
+@dataclass(frozen=True)
+class Justification:
+    """A dealer's public answer to a complaint: the disputed plaintext
+    sub-share, verifiable by anyone against the commitments (which ride
+    along so old-only resharing nodes — who receive no deals — can check
+    it too)."""
+
+    dealer_index: int
+    verifier_index: int           # the complainer
+    share_value: int              # revealed sub-share (mod R)
+    commits_bytes: tuple          # dealer's commitment polynomial
+    #: Schnorr by the dealer: only a justification provably FROM the
+    #: dealer may convict it (an unsigned garbage justification must
+    #: never mark an honest dealer bad)
+    signature: bytes = b""
+
+    def commits(self) -> List[tuple]:
+        return [ref.g1_from_bytes(b) for b in self.commits_bytes]
+
+    def signed_payload(self, session_id: bytes) -> bytes:
+        return (b"drand-tpu-dkg-just" + session_id
+                + self.dealer_index.to_bytes(4, "big")
+                + self.verifier_index.to_bytes(4, "big")
+                + self.share_value.to_bytes(32, "big")
+                + b"".join(self.commits_bytes))
+
+    def to_dict(self) -> dict:
+        return {
+            "dealer_index": self.dealer_index,
+            "verifier_index": self.verifier_index,
+            "share_value": "%064x" % self.share_value,
+            "commits": [b.hex() for b in self.commits_bytes],
+            "signature": self.signature.hex(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Justification":
+        return cls(
+            dealer_index=int(d["dealer_index"]),
+            verifier_index=int(d["verifier_index"]),
+            share_value=int(d["share_value"], 16),
+            commits_bytes=tuple(bytes.fromhex(h) for h in d["commits"]),
+            signature=bytes.fromhex(d.get("signature", "")),
         )
 
 
@@ -112,8 +193,11 @@ class DistKeyGenerator:
         old_threshold: Optional[int] = None,
         old_dist_commits: Optional[Sequence[tuple]] = None,
         entropy: Optional[bytes] = None,
+        session_id: bytes = b"",
     ):
         self.pair = pair
+        #: domain-separates signatures across DKG runs (the group hash)
+        self.session_id = session_id
         self.participants = list(participants)
         self.threshold = threshold
         self.reshare = old_participants is not None
@@ -154,6 +238,13 @@ class DistKeyGenerator:
         self._commits_seen: Dict[int, tuple] = {}     # dealer -> commits
         self._approvals: Dict[int, set] = {}          # dealer -> verifiers
         self._complaints: Dict[int, set] = {}
+        #: dealers proven malicious (invalid justification) — never QUAL
+        self._bad_dealers: set = set()
+        #: complaints we (as dealer) already answered, (dealer, verifier)
+        self._justified: set = set()
+        #: justifications that arrived before the complaint they answer
+        #: (async networks may invert the order), (dealer, verifier) -> J
+        self._early_justs: Dict = {}
 
     @staticmethod
     def _find_index(nodes: Sequence[Identity],
@@ -176,14 +267,15 @@ class DistKeyGenerator:
             blob = share.value.to_bytes(32, "big")
             enc = ecies.encrypt(node.key, blob,
                                 associated_data=self._ad(j))
-            out.append(
-                Deal(
-                    dealer_index=self.dealer_index,
-                    recipient_index=j,
-                    commits_bytes=tuple(self._commits),
-                    encrypted_share=enc,
-                )
+            deal = Deal(
+                dealer_index=self.dealer_index,
+                recipient_index=j,
+                commits_bytes=tuple(self._commits),
+                encrypted_share=enc,
             )
+            out.append(replace(deal, signature=schnorr.sign(
+                self.pair.private, deal.signed_payload(self.session_id)
+            )))
         return out
 
     def _ad(self, recipient_index: int) -> bytes:
@@ -200,6 +292,15 @@ class DistKeyGenerator:
         d = deal.dealer_index
         if not (0 <= d < len(self.old_participants)):
             raise DKGError("unknown dealer index")
+        # authenticate BEFORE judging content: a forged deal must be
+        # dropped outright, never answered with a complaint (the
+        # complaint would trigger a public sub-share justification)
+        if not schnorr.verify(
+            self.old_participants[d].key,
+            deal.signed_payload(self.session_id),
+            deal.signature,
+        ):
+            raise DKGError("deal signature invalid")
         if d in self._received:
             raise DKGError("duplicate deal")
         approved = False
@@ -226,19 +327,138 @@ class DistKeyGenerator:
             approved = False
         resp = Response(dealer_index=d, verifier_index=self.index,
                         approved=approved)
+        resp = replace(resp, signature=schnorr.sign(
+            self.pair.private, resp.signed_payload(self.session_id)
+        ))
         self.process_response(resp)
         return resp
 
     def process_response(self, resp: Response) -> None:
-        if not (0 <= resp.dealer_index < len(self.old_participants)):
+        """One response per (dealer, verifier): the first wins (kyber
+        rejects duplicate responses, so a late forged complaint cannot
+        override an already-recorded approval)."""
+        d, v = resp.dealer_index, resp.verifier_index
+        if not (0 <= d < len(self.old_participants)):
             raise DKGError("unknown dealer index in response")
-        if not (0 <= resp.verifier_index < len(self.participants)):
+        if not (0 <= v < len(self.participants)):
             raise DKGError("unknown verifier index in response")
+        if not schnorr.verify(
+            self.participants[v].key,
+            resp.signed_payload(self.session_id),
+            resp.signature,
+        ):
+            raise DKGError("response signature invalid")
+        if (v in self._approvals.get(d, ())
+                or v in self._complaints.get(d, ())):
+            return
         target = (self._approvals if resp.approved
                   else self._complaints)
-        target.setdefault(resp.dealer_index, set()).add(
-            resp.verifier_index
+        target.setdefault(d, set()).add(v)
+        if not resp.approved:
+            early = self._early_justs.pop((d, v), None)
+            if early is not None:
+                self.process_justification(early)
+
+    # -- justification round ----------------------------------------------
+
+    def pending_complaints(self) -> List[Response]:
+        """Complaints against OUR dealing that we have not yet answered."""
+        if not self.is_dealer:
+            return []
+        d = self.dealer_index
+        return [
+            Response(dealer_index=d, verifier_index=v, approved=False)
+            for v in sorted(self._complaints.get(d, ()))
+            if (d, v) not in self._justified
+        ]
+
+    def justify(self, complaint: Response) -> Justification:
+        """Answer a complaint against our dealing by revealing the
+        disputed plaintext sub-share (it becomes public; the dealing
+        stays certified).  Mirrors kyber vss Justification
+        (/root/reference/protobuf/crypto/vss/vss.proto:60-69)."""
+        if not self.is_dealer:
+            raise DKGError("not a dealer in this DKG")
+        if complaint.dealer_index != self.dealer_index:
+            raise DKGError("complaint is not about our dealing")
+        if complaint.approved:
+            raise DKGError("response is not a complaint")
+        v = complaint.verifier_index
+        if not (0 <= v < len(self.participants)):
+            raise DKGError("unknown verifier index")
+        self._justified.add((self.dealer_index, v))
+        just = Justification(
+            dealer_index=self.dealer_index,
+            verifier_index=v,
+            share_value=self._poly.eval(v).value,
+            commits_bytes=tuple(self._commits),
         )
+        return replace(just, signature=schnorr.sign(
+            self.pair.private, just.signed_payload(self.session_id)
+        ))
+
+    def process_justification(self, just: Justification) -> None:
+        """Re-verify a revealed sub-share against the dealer's
+        commitments.  Valid: the complaint is neutralized (counts as the
+        complainer's approval; the complainer — if us — adopts the
+        now-public sub-share).  Invalid: the dealer is proven malicious
+        and excluded from QUAL outright."""
+        d = just.dealer_index
+        v = just.verifier_index
+        if not (0 <= d < len(self.old_participants)):
+            raise DKGError("unknown dealer index in justification")
+        if not (0 <= v < len(self.participants)):
+            raise DKGError("unknown verifier index in justification")
+        # authenticity gate: only a justification provably signed by the
+        # dealer may count AGAINST it — an unsigned forgery is dropped
+        # here (raising), never recorded in _bad_dealers
+        if not schnorr.verify(
+            self.old_participants[d].key,
+            just.signed_payload(self.session_id),
+            just.signature,
+        ):
+            raise DKGError("justification signature invalid")
+        # a justification must ANSWER a recorded complaint (kyber's
+        # aggregator rejects unsolicited ones): without this gate a rogue
+        # dealer could self-certify by publishing justifications for
+        # every verifier, bypassing genuine approvals entirely.  If the
+        # complaint simply hasn't arrived yet (async ordering), buffer
+        # the justification and replay it from process_response.
+        if v not in self._complaints.get(d, ()):
+            self._early_justs[(d, v)] = just
+            return
+        try:
+            commits = just.commits()
+            if len(commits) != self.threshold:
+                raise DKGError("bad commitment count")
+            if any(c is None for c in commits):
+                raise DKGError("invalid commitment point")
+            # commits must be THE dealer's commits: match what our own
+            # deal carried (when we got one), and in a reshare the free
+            # coefficient must still re-share the dealer's old share
+            seen = self._commits_seen.get(d)
+            if seen is not None and tuple(commits) != tuple(seen):
+                raise DKGError("justification commits differ from deal")
+            if self.reshare and self.old_dist_commits is not None:
+                if commits[0] != _eval_commits(self.old_dist_commits, d):
+                    raise DKGError("dealer does not re-share its share")
+            value = just.share_value % ref.R
+            if ref.g1_mul(ref.G1_GEN, value) != _eval_commits(commits, v):
+                raise DKGError("revealed sub-share fails commitments")
+        except DKGError:
+            # provably cheating: an honest dealer can always produce a
+            # valid justification for its own dealing
+            self._bad_dealers.add(d)
+            self._approvals.pop(d, None)
+            return
+        # valid: neutralize the complaint
+        self._complaints.get(d, set()).discard(v)
+        self._approvals.setdefault(d, set()).add(v)
+        if v == self.index and d not in self._received:
+            # we were the complainer (e.g. undecryptable deal): adopt the
+            # now-public sub-share so QUAL membership of d stays usable
+            self._received[d] = PriShare(self.index, value)
+            self._commits_seen[d] = tuple(commits)
 
     # -- certification ----------------------------------------------------
 
@@ -249,9 +469,17 @@ class DistKeyGenerator:
         reference's retiring nodes."""
         return self.index is None or d in self._received
 
+    def _dealer_ok(self, d: int) -> bool:
+        """Not proven malicious and no unanswered complaint (kyber's
+        DealCertified: a standing complaint excludes the dealer until a
+        valid justification clears it)."""
+        return d not in self._bad_dealers and not self._complaints.get(d)
+
     def _certified_dealers(self) -> List[int]:
         out = []
         for d, verifiers in self._approvals.items():
+            if not self._dealer_ok(d):
+                continue
             if len(verifiers) >= self.threshold and self._have_deal(d):
                 out.append(d)
         return sorted(out)
@@ -261,7 +489,9 @@ class DistKeyGenerator:
         n = len(self.participants)
         dealers = range(len(self.old_participants))
         return all(
-            len(self._approvals.get(d, ())) >= n and self._have_deal(d)
+            self._dealer_ok(d)
+            and len(self._approvals.get(d, ())) >= n
+            and self._have_deal(d)
             for d in dealers
         )
 

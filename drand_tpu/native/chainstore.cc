@@ -29,6 +29,7 @@
 #include <vector>
 
 #include <fcntl.h>
+#include <sys/file.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
@@ -119,6 +120,7 @@ bool load(Store* s) {
   if (size < 0) return false;
   if (size == 0) {
     if (pwrite(s->fd, kMagic, 8, 0) != 8) return false;
+    if (::fsync(s->fd) != 0) return false;  // header durable before use
     return true;
   }
   char magic[8];
@@ -141,8 +143,10 @@ bool load(Store* s) {
     off += 8 + len;
   }
   if (off < size) {
-    // torn tail from a crash mid-append: drop it
+    // torn tail from a crash mid-append: drop it (durably, so a second
+    // crash cannot resurrect the garbage)
     if (ftruncate(s->fd, off) != 0) return false;
+    if (::fsync(s->fd) != 0) return false;
   }
   return true;
 }
@@ -170,6 +174,14 @@ void* dtcs_open(const char* path, int fsync_puts) {
   s->fsync_puts = fsync_puts != 0;
   if (path != nullptr && path[0] != '\0') {
     s->fd = ::open(path, O_RDWR | O_CREAT, 0600);
+    // single-writer discipline (the reference's boltdb flocks its DB):
+    // a second process opening the same log would interleave appends
+    // against a divergent in-memory index
+    if (s->fd >= 0 && flock(s->fd, LOCK_EX | LOCK_NB) != 0) {
+      ::close(s->fd);
+      delete s;
+      return nullptr;
+    }
     if (s->fd < 0 || !load(s)) {
       if (s->fd >= 0) ::close(s->fd);
       delete s;

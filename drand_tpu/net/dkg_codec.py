@@ -1,0 +1,87 @@
+"""Typed wire codec for DKG packets.
+
+Round 1 shipped deals/responses as JSON blobs inside a bytes field; the
+reference carries typed proto messages
+(/root/reference/protobuf/crypto/dkg/dkg.proto:210-248, justification at
+protobuf/crypto/vss/vss.proto:60-69).  This codec maps the engine's
+in-memory packet dicts (drand_tpu.dkg.pedersen to_dict/from_dict forms)
+onto the typed `DKGPacketMsg` oneof, so the wire schema is
+self-describing and length-checked by protobuf instead of free-form
+JSON.
+"""
+
+from __future__ import annotations
+
+from drand_tpu.net import drand_tpu_pb2 as pb
+
+
+class CodecError(ValueError):
+    pass
+
+
+def packet_to_msg(packet: dict, group_hash: bytes) -> "pb.DKGPacketMsg":
+    """Engine packet dict -> typed wire message."""
+    msg = pb.DKGPacketMsg(group_hash=group_hash)
+    if "dkg_deal" in packet:
+        d = packet["dkg_deal"]
+        msg.deal.CopyFrom(pb.DealMsg(
+            dealer_index=int(d["dealer_index"]),
+            recipient_index=int(d["recipient_index"]),
+            commits=[bytes.fromhex(h) for h in d["commits"]],
+            encrypted_share=bytes.fromhex(d["encrypted_share"]),
+            signature=bytes.fromhex(d.get("signature", "")),
+        ))
+    elif "dkg_response" in packet:
+        r = packet["dkg_response"]
+        msg.response.CopyFrom(pb.ResponseMsg(
+            dealer_index=int(r["dealer_index"]),
+            verifier_index=int(r["verifier_index"]),
+            approved=bool(r["approved"]),
+            signature=bytes.fromhex(r.get("signature", "")),
+        ))
+    elif "dkg_justification" in packet:
+        j = packet["dkg_justification"]
+        msg.justification.CopyFrom(pb.JustificationMsg(
+            dealer_index=int(j["dealer_index"]),
+            verifier_index=int(j["verifier_index"]),
+            share_value=bytes.fromhex(j["share_value"]),
+            commits=[bytes.fromhex(h) for h in j["commits"]],
+            signature=bytes.fromhex(j.get("signature", "")),
+        ))
+    else:
+        raise CodecError(f"unknown DKG packet keys: {sorted(packet)}")
+    return msg
+
+
+def msg_to_packet(msg: "pb.DKGPacketMsg") -> dict:
+    """Typed wire message -> engine packet dict."""
+    body = msg.WhichOneof("body")
+    if body == "deal":
+        d = msg.deal
+        return {"dkg_deal": {
+            "dealer_index": d.dealer_index,
+            "recipient_index": d.recipient_index,
+            "commits": [c.hex() for c in d.commits],
+            "encrypted_share": d.encrypted_share.hex(),
+            "signature": d.signature.hex(),
+        }}
+    if body == "response":
+        r = msg.response
+        return {"dkg_response": {
+            "dealer_index": r.dealer_index,
+            "verifier_index": r.verifier_index,
+            "approved": r.approved,
+            "signature": r.signature.hex(),
+        }}
+    if body == "justification":
+        j = msg.justification
+        if len(j.share_value) != 32:
+            raise CodecError("justification share must be 32 bytes")
+        return {"dkg_justification": {
+            "dealer_index": j.dealer_index,
+            "verifier_index": j.verifier_index,
+            "share_value": j.share_value.hex(),
+            "commits": [c.hex() for c in j.commits],
+            "signature": j.signature.hex(),
+        }}
+    raise CodecError("DKG packet carries no body")

@@ -98,9 +98,14 @@ def build_rest_app(daemon) -> web.Application:
 
 
 async def start_rest(app: web.Application, port: int,
-                     host: str = "0.0.0.0") -> web.AppRunner:
+                     host: str = "0.0.0.0",
+                     ssl_context=None) -> web.AppRunner:
+    """Serve the gateway; pass an `ssl.SSLContext` to serve HTTPS (the
+    reference serves REST through the same TLS listener as gRPC,
+    net/listener_grpc.go:108-168 — here it is the same certificate on
+    the REST port)."""
     runner = web.AppRunner(app)
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    site = web.TCPSite(runner, host, port, ssl_context=ssl_context)
     await site.start()
     return runner

@@ -19,11 +19,19 @@ from cryptography.hazmat.primitives.asymmetric import ec
 from cryptography.x509.oid import NameOID
 
 
-def generate_self_signed(host: str) -> Tuple[bytes, bytes]:
-    """Return (cert_pem, key_pem) for a host ('127.0.0.1' or DNS name)."""
+def generate_self_signed(host: str,
+                         common_name: Optional[str] = None
+                         ) -> Tuple[bytes, bytes]:
+    """Return (cert_pem, key_pem) for a host ('127.0.0.1' or DNS name).
+
+    `common_name` should be UNIQUE per node when many self-signed certs
+    share one trust pool: issuer lookup is by subject name, and several
+    roots with identical names make the TLS stack pick an arbitrary one
+    (handshakes then fail with CERTIFICATE_VERIFY_FAILED).
+    """
     key = ec.generate_private_key(ec.SECP256R1())
     name = x509.Name(
-        [x509.NameAttribute(NameOID.COMMON_NAME, host)]
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name or host)]
     )
     try:
         san: x509.GeneralName = x509.IPAddress(

@@ -11,8 +11,6 @@ defined by the `_METHODS` tables below.
 from __future__ import annotations
 
 import asyncio
-import json
-import logging
 from typing import AsyncIterator, Dict, Optional
 
 import grpc
@@ -21,10 +19,9 @@ import grpc.aio
 from drand_tpu.beacon.chain import Beacon
 from drand_tpu.beacon.handler import BeaconPacket, ProtocolClient
 from drand_tpu.key import Identity
+from drand_tpu.net import dkg_codec
 from drand_tpu.net import drand_tpu_pb2 as pb
 from drand_tpu.net.tls import CertManager
-
-log = logging.getLogger("drand_tpu.net")
 
 # The reference uses a 1s per-RPC deadline (beacon/beacon.go:89); ours is
 # longer because peers may be busy in Python crypto on small hosts.
@@ -206,9 +203,9 @@ def build_public_server(daemon, address: str,
 
 async def _dkg_inbound(daemon, request, context, reshare: bool):
     try:
-        payload = json.loads(request.payload.decode())
-    except (UnicodeDecodeError, json.JSONDecodeError):
-        await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "bad payload")
+        payload = dkg_codec.msg_to_packet(request)
+    except (dkg_codec.CodecError, ValueError):
+        await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "bad packet")
         return
     try:
         await daemon.process_dkg_packet(
@@ -435,9 +432,7 @@ class GrpcClient(ProtocolClient):
             peer, f"/{PROTOCOL_SERVICE}/{name}",
             pb.DKGPacketMsg.SerializeToString, pb.Empty.FromString,
         )
-        msg = pb.DKGPacketMsg(
-            payload=json.dumps(packet).encode(), group_hash=group_hash
-        )
+        msg = dkg_codec.packet_to_msg(packet, group_hash)
         last_exc = None
         for attempt in range(4):
             try:

@@ -286,3 +286,27 @@ def g2_decode(p):
         return None
     x, y = g2_to_affine(p)
     return (tower.fp2_decode(x), tower.fp2_decode(y))
+
+
+def g1_affine_encode_batch(pts) -> jnp.ndarray:
+    """Oracle affine G1 points -> (B, 2, NLIMB) in ONE device dispatch
+    (the per-point path costs one device round-trip per coordinate —
+    dominant at catch-up batch sizes)."""
+    flat = [c for p in pts for c in (p[0], p[1])]
+    return fp.encode_batch(flat).reshape(len(pts), 2, fp.NLIMB)
+
+
+def g2_affine_encode_batch(pts) -> jnp.ndarray:
+    """Oracle affine G2 points -> (B, 2, 2, NLIMB), one dispatch."""
+    flat = [c for p in pts for xy in p for c in (xy[0], xy[1])]
+    return fp.encode_batch(flat).reshape(len(pts), 2, 2, fp.NLIMB)
+
+
+def g2_encode_batch(pts) -> jnp.ndarray:
+    """Oracle affine G2 points -> projective (B, 3, 2, NLIMB) with Z=1,
+    one dispatch (feeds scalar_mul / MSM)."""
+    aff = g2_affine_encode_batch(pts)
+    one = jnp.broadcast_to(
+        tower.fp2_encode((1, 0)), (len(pts), 1, 2, fp.NLIMB)
+    )
+    return jnp.concatenate([aff, one], axis=1)

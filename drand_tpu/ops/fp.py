@@ -435,6 +435,17 @@ def fp_encode(x: int) -> jnp.ndarray:
     return to_mont(jnp.asarray(int_to_limbs(x % P)))
 
 
+def encode_batch(vals) -> jnp.ndarray:
+    """Many ints -> Montgomery limbs in ONE device dispatch.
+
+    Per-element `fp_encode` costs one device round-trip each (to_mont is
+    a mont_mul); at catch-up batch sizes that dominated wall time over
+    the axon tunnel.  Here the limb decomposition happens in numpy and a
+    single batched to_mont runs on device: (B, NLIMB)."""
+    arr = np.stack([int_to_limbs(v % P) for v in vals])
+    return to_mont(jnp.asarray(arr))
+
+
 def fp_decode(a) -> int:
     """Montgomery limbs -> canonical python int (canon guarantees < p)."""
     return limbs_to_int(np.asarray(canon(a)))

@@ -1,0 +1,300 @@
+"""Device hash-to-curve for G2 — batched, branchless (JAX).
+
+The reference hashes every beacon message into G2 inside kyber's
+`Sign`/`VerifyPartial`/`VerifyRecovered` (/root/reference/key/curve.go:30,
+consumed at /root/reference/beacon/beacon.go:433,148,494).  Round 1 left
+this on the host (pure-Python `refimpl.hash_to_g2`, ~0.6 s/message),
+which capped the real end-to-end catch-up path at ~1.5 rounds/s no matter
+how fast the pairing kernel was.  This module moves the expensive field
+work onto the device:
+
+* host (cheap, stays in Python): `expand_message_xmd` SHA-256 draws —
+  microseconds per message;
+* device (batched over messages): the SVDW map to the twist curve
+  (RFC 9380 §6.6.1 straight-line form: two `is_square` Legendre pows, one
+  Fp2 sqrt, all branchless selects), point addition of the two mapped
+  points, and Budroni–Pintore fast cofactor clearing
+  ([x²−x−1]P + [x−1]ψ(P) + ψ²(2P) — three 64-bit ladders instead of one
+  507-bit ladder).
+
+`refimpl.hash_to_g2` implements the *identical* map and clearing formula
+in pure Python, so host-signed and device-verified messages agree by
+construction; `tests/test_h2c.py` asserts the parity.
+
+Fp2 sqrt uses the q ≡ 9 (mod 16) branchless recipe (RFC 9380 §G.1.3):
+one fixed 759-bit exponentiation plus a 4-way select among
+`x^((q+7)/16) · {1, √-1, √√-1, √-√-1}`.  Any root works — the SVDW sign
+adjustment (`sgn0(u) == sgn0(y)`) makes the final choice deterministic.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.ops import fp, tower
+from drand_tpu.ops.curve import (
+    F2,
+    point_add,
+    point_double,
+    point_neg,
+    to_affine,
+)
+from drand_tpu.ops.pairing import MILLER_BITS, _segment_scan
+
+# --------------------------------------------------------------------------
+# Constants (derived from the oracle at import; all checked by parity
+# tests, nothing hand-entered).
+# --------------------------------------------------------------------------
+
+
+def _c2(v) -> np.ndarray:
+    """Oracle Fp2 tuple -> Montgomery limb constant (2, NLIMB)."""
+    return np.stack([
+        fp.int_to_limbs(v[0] * fp.R_MONT % ref.P),
+        fp.int_to_limbs(v[1] * fp.R_MONT % ref.P),
+    ])
+
+
+_S = ref.SVDW_G2
+SVDW_Z = _c2(_S.Z)
+SVDW_C1 = _c2(_S.c1)   # g(Z)
+SVDW_C2 = _c2(_S.c2)   # -Z/2
+SVDW_C3 = _c2(_S.c3)   # sqrt(-g(Z)·3Z²), sign-normalized
+SVDW_C4 = _c2(_S.c4)   # -4·g(Z)/(3Z²)
+B2_C = _c2(ref.B2)
+
+PSI_CX = _c2(ref.PSI_CX)
+PSI_CY = _c2(ref.PSI_CY)
+
+# Fp2 sqrt for q = p² ≡ 9 (mod 16)
+assert (ref.P * ref.P) % 16 == 9
+E_SQRT = (ref.P * ref.P + 7) // 16
+E_LEG = (ref.P - 1) // 2
+_SQ2 = ref.fp2_sqrt((0, 1))            # sqrt(i); i itself is sqrt(-1)
+_SQ3 = ref.fp2_sqrt((0, ref.P - 1))    # sqrt(-i)
+assert _SQ2 is not None and _SQ3 is not None
+SQ_C1 = _c2((0, 1))
+SQ_C2 = _c2(_SQ2)
+SQ_C3 = _c2(_SQ3)
+
+
+# --------------------------------------------------------------------------
+# Fp2 exponentiation / square-detection / sqrt (branchless).
+# --------------------------------------------------------------------------
+
+
+def _w2(c, shape):
+    """Broadcast a (2, L) constant across a batch shape."""
+    return jnp.broadcast_to(jnp.asarray(c), (*shape, *c.shape))
+
+
+@partial(jax.jit, static_argnums=1)
+def fp2_pow_static(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e in Fp2 for a static exponent — MSB-first scan over bits."""
+    assert e > 0
+    bits = np.array([int(c) for c in bin(e)[2:]], dtype=np.int32)
+
+    def step(acc, bit):
+        acc = tower.fp2_sqr(acc)
+        acc = jnp.where(bit != 0, tower.fp2_mul(acc, a), acc)
+        return acc, None
+
+    acc0 = tower.fp2_one(a.shape[:-2])
+    out, _ = lax.scan(step, acc0, jnp.asarray(bits))
+    return out
+
+
+@jax.jit
+def fp2_is_square(a: jnp.ndarray) -> jnp.ndarray:
+    """Legendre test via the norm: a square in Fp2 iff its norm
+    a0² + a1² is a square in Fp (one 380-bit Fp pow, not a 762-bit
+    Fp2 pow)."""
+    c0 = jnp.take(a, 0, axis=-2)
+    c1 = jnp.take(a, 1, axis=-2)
+    norm = fp.add(fp.mont_sqr(c0), fp.mont_sqr(c1))
+    ls = fp.mont_pow(norm, E_LEG)
+    return fp.eq(ls, fp.one_mont(ls.shape[:-1])) | fp.is_zero(norm)
+
+
+@jax.jit
+def fp2_sqrt_any(a: jnp.ndarray) -> jnp.ndarray:
+    """One square root of a (assuming a IS a square; garbage otherwise).
+
+    Branchless: tv = a^((q+7)/16); the root is tv·c for exactly one
+    c ∈ {1, √-1, √√-1, √-√-1} — select by squaring each candidate.
+    """
+    shape = a.shape[:-2]
+    tv = fp2_pow_static(a, E_SQRT)
+    cands = [
+        tv,
+        tower.fp2_mul(tv, _w2(SQ_C1, shape)),
+        tower.fp2_mul(tv, _w2(SQ_C2, shape)),
+        tower.fp2_mul(tv, _w2(SQ_C3, shape)),
+    ]
+    out = cands[0]
+    for c in cands[1:]:
+        good = tower.fp2_eq(tower.fp2_sqr(c), a)
+        out = jnp.where(good[..., None, None], c, out)
+    return out
+
+
+@jax.jit
+def fp2_sgn0(a: jnp.ndarray) -> jnp.ndarray:
+    """RFC 9380 sgn0 for m=2 (matches refimpl.fp2_sgn0)."""
+    c = fp.canon(a)
+    c0 = jnp.take(c, 0, axis=-2)
+    c1 = jnp.take(c, 1, axis=-2)
+    s0 = c0[..., 0] & 1
+    z0 = jnp.all(c0 == 0, axis=-1)
+    s1 = c1[..., 0] & 1
+    return s0 | (z0.astype(s0.dtype) & s1)
+
+
+# --------------------------------------------------------------------------
+# SVDW map to the twist curve.
+# --------------------------------------------------------------------------
+
+
+def _g(x, shape):
+    """g(x) = x³ + B2 on the twist."""
+    return tower.fp2_add(
+        tower.fp2_mul(tower.fp2_sqr(x), x), _w2(B2_C, shape)
+    )
+
+
+@jax.jit
+def map_to_curve_g2(u: jnp.ndarray) -> jnp.ndarray:
+    """SVDW map: field element u (..., 2, L) -> projective twist point
+    (..., 3, 2, L).  Straight-line version of refimpl._SVDW.map_to_curve
+    with `where` selects in place of the is_square branches."""
+    shape = u.shape[:-2]
+    one = tower.fp2_one(shape)
+
+    tv1 = tower.fp2_mul(tower.fp2_sqr(u), _w2(SVDW_C1, shape))
+    tv2 = tower.fp2_add(one, tv1)
+    tv1 = tower.fp2_sub(one, tv1)
+    tv3 = tower.fp2_inv(tower.fp2_mul(tv1, tv2))  # inv(0) = 0
+    tv4 = tower.fp2_mul(
+        tower.fp2_mul(tower.fp2_mul(u, tv1), tv3), _w2(SVDW_C3, shape)
+    )
+    x1 = tower.fp2_sub(_w2(SVDW_C2, shape), tv4)
+    x2 = tower.fp2_add(_w2(SVDW_C2, shape), tv4)
+    sq = tower.fp2_sqr(tower.fp2_mul(tower.fp2_sqr(tv2), tv3))
+    x3 = tower.fp2_add(
+        tower.fp2_mul(sq, _w2(SVDW_C4, shape)), _w2(SVDW_Z, shape)
+    )
+
+    e1 = fp2_is_square(_g(x1, shape))[..., None, None]
+    e2 = fp2_is_square(_g(x2, shape))[..., None, None]
+    x = jnp.where(e1, x1, jnp.where(e2, x2, x3))
+    y = fp2_sqrt_any(_g(x, shape))
+    flip = (fp2_sgn0(u) != fp2_sgn0(y))[..., None, None]
+    y = jnp.where(flip, tower.fp2_neg(y), y)
+    return jnp.stack([x, y, one], axis=-3)
+
+
+# --------------------------------------------------------------------------
+# psi endomorphism + fast cofactor clearing.
+# --------------------------------------------------------------------------
+
+
+@jax.jit
+def g2_psi(p: jnp.ndarray) -> jnp.ndarray:
+    """psi on projective coords: (X:Y:Z) -> (cx·X̄ : cy·Ȳ : Z̄)."""
+    shape = p.shape[:-3]
+    x = tower.fp2_conj(jnp.take(p, 0, axis=-3))
+    y = tower.fp2_conj(jnp.take(p, 1, axis=-3))
+    z = tower.fp2_conj(jnp.take(p, 2, axis=-3))
+    return jnp.stack([
+        tower.fp2_mul(_w2(PSI_CX, shape), x),
+        tower.fp2_mul(_w2(PSI_CY, shape), y),
+        z,
+    ], axis=-3)
+
+
+def _mul_neg_x(p: jnp.ndarray) -> jnp.ndarray:
+    """[x]P for the negative BLS parameter x (= -[|x|]P).
+
+    |x| has popcount 6, so the ladder runs as a segment scan over its
+    zero runs (same machinery as the Miller loop): 63 doublings, 6 adds.
+    """
+    def dbl(pt):
+        return point_double(pt, F2)
+
+    def dbl_add_base(pt):
+        # the segment scan's mul_step owns the 1-bit's doubling too
+        # (zero-run sqr_steps cover only the 0-bits)
+        return point_add(point_double(pt, F2), p, F2)
+
+    acc = _segment_scan(p, MILLER_BITS, dbl, dbl_add_base)
+    return point_neg(acc, F2)
+
+
+@jax.jit
+def clear_cofactor_g2(p: jnp.ndarray) -> jnp.ndarray:
+    """h_eff·P = [x²−x−1]P + [x−1]ψ(P) + ψ²(2P) (matches
+    refimpl.g2_clear_cofactor exactly).
+
+    Computed with TWO x-ladders instead of three:
+      A = [x]P,  B = [x](A + ψ(P)) = [x²]P + [x]ψ(P)
+      result = B − A − P − ψ(P) + ψ²(2P)
+    (the second ladder reuses A, saving ~64 doublings per point)."""
+    psip = g2_psi(p)
+    a = _mul_neg_x(p)
+    b = _mul_neg_x(point_add(a, psip, F2))
+    acc = point_add(b, point_neg(point_add(a, p, F2), F2), F2)
+    acc = point_add(acc, point_neg(psip, F2), F2)
+    return point_add(acc, g2_psi(g2_psi(point_double(p, F2))), F2)
+
+
+@jax.jit
+def map_and_clear_g2(u0: jnp.ndarray, u1: jnp.ndarray) -> jnp.ndarray:
+    """(u0, u1) field draws -> hashed point in G2, projective."""
+    q = point_add(map_to_curve_g2(u0), map_to_curve_g2(u1), F2)
+    return clear_cofactor_g2(q)
+
+
+@jax.jit
+def map_and_clear_g2_affine(u0: jnp.ndarray, u1: jnp.ndarray):
+    """Same, returned as affine (x, y) stacked (..., 2, 2, L) for the
+    pairing kernels (which take affine Q inputs)."""
+    x, y = to_affine(map_and_clear_g2(u0, u1), F2)
+    return jnp.stack([x, y], axis=-3)
+
+
+# --------------------------------------------------------------------------
+# Batch API (host draws -> device points).
+# --------------------------------------------------------------------------
+
+
+def hash_to_field_device(msgs, dst: bytes = ref.DST_G2):
+    """expand_message_xmd on host (cheap SHA-256), encoded as device
+    Montgomery limb batches: (B, 2, L) u0 and u1 — ONE to_mont dispatch
+    per draw batch (per-element encoding cost one device round-trip each
+    and dominated end-to-end wall time over the axon tunnel)."""
+    draws = [ref.hash_to_field_fp2(m, 2, dst) for m in msgs]
+    u0 = tower.fp2_encode_batch([d[0] for d in draws])
+    u1 = tower.fp2_encode_batch([d[1] for d in draws])
+    return u0, u1
+
+
+def hash_to_g2_batch(msgs, dst: bytes = ref.DST_G2) -> jnp.ndarray:
+    """Messages -> G2 points on device, affine (B, 2, 2, L).
+
+    Parity: decoding row i equals refimpl.hash_to_g2(msgs[i], dst).
+    """
+    u0, u1 = hash_to_field_device(msgs, dst)
+    return map_and_clear_g2_affine(u0, u1)
+
+
+def hash_to_g2_batch_proj(msgs, dst: bytes = ref.DST_G2) -> jnp.ndarray:
+    """Messages -> G2 points on device, projective (B, 3, 2, L) — for
+    consumers that keep computing (e.g. sign's scalar mult)."""
+    u0, u1 = hash_to_field_device(msgs, dst)
+    return map_and_clear_g2(u0, u1)

@@ -1,0 +1,411 @@
+"""Pallas hash-to-curve for G2 + the fused hashed pairing check.
+
+ops/h2c.py runs the H2C field work as an XLA op graph; on the TPU target
+each op execution carries a large fixed cost, so that path is op-count
+bound exactly like the op-graph pairing was (round-1 lesson).  This
+module runs the same math — SVDW map, q ≡ 9 (mod 16) sqrt, psi-based
+fast cofactor clearing — inside the Pallas mega-kernel framework
+(limbs-on-sublanes, shared constant table, segment-scan ladders), giving
+two entry points:
+
+* :func:`hash_to_g2` — batched `u -> affine G2 point` kernel;
+* :func:`pairing_product_check_hashed` — the END-TO-END verify kernel:
+  Q2 = H(m) is computed in-kernel and fed straight into the double
+  Miller loop + final exponentiation, so a full beacon-round
+  verification (bytes -> bool) is ONE device op.
+
+Parity: identical formulas to ops/h2c.py / refimpl.hash_to_g2 (the
+two-ladder Budroni–Pintore decomposition: A = [x]P, B = [x](A + psi(P)),
+result = B − A − P − psi(P) + psi²(2P)).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.ops import pallas_pairing as pp
+from drand_tpu.ops.pallas_pairing import (
+    NL,
+    _bit,
+    _cc,
+    _from_mont,
+    _segment_scan,
+    f_add,
+    f_mul,
+    f_neg,
+    f_one,
+    f_sub,
+    fp2_add,
+    fp2_conj,
+    fp2_inv,
+    fp2_mul,
+    fp2_neg,
+    fp2_one,
+    fp2_sqr,
+    fp2_sub,
+    point_add2,
+    point_double2,
+)
+
+BIT_LEN = pp.BIT_LEN
+
+
+def _fc2(name, b):
+    """Broadcast a registered Fp2 constant to (NL, b) component arrays."""
+    return (
+        jnp.broadcast_to(_cc(f"{name}_0"), (NL, b)).astype(jnp.int32),
+        jnp.broadcast_to(_cc(f"{name}_1"), (NL, b)).astype(jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fp / Fp2 predicates and exponentiations (rows are (1, B) masks).
+# ---------------------------------------------------------------------------
+
+
+def _f_is_zero_row(a):
+    return jnp.all(_from_mont(a) == 0, axis=0, keepdims=True)
+
+
+def _f_eq_row(a, b):
+    return _f_is_zero_row(f_sub(a, b))
+
+
+def _fp2_eq_row(a, b):
+    return _f_eq_row(a[0], b[0]) & _f_eq_row(a[1], b[1])
+
+
+def _f_pow_pat(a, name):
+    """a^e for the named static bit pattern (MSB is 1)."""
+
+    def body(i, acc):
+        acc = f_mul(acc, acc)
+        mul = f_mul(acc, a)
+        return jnp.where(_bit(name, i) != 0, mul, acc)
+
+    return lax.fori_loop(1, BIT_LEN[name], body, a)
+
+
+def _fp2_pow_pat(a, name):
+    a_st = jnp.concatenate([a[0], a[1]], axis=0)
+
+    def body(i, st):
+        acc = (st[:NL], st[NL:])
+        acc = fp2_sqr(acc)
+        mul = fp2_mul(acc, a)
+        pick = _bit(name, i) != 0
+        return jnp.concatenate(
+            [
+                jnp.where(pick, mul[0], acc[0]),
+                jnp.where(pick, mul[1], acc[1]),
+            ],
+            axis=0,
+        )
+
+    out = lax.fori_loop(1, BIT_LEN[name], body, a_st)
+    return (out[:NL], out[NL:])
+
+
+def fp2_is_square_row(a):
+    """Legendre via the norm (one Fp pow): (1, B) bool."""
+    norm = f_add(f_mul(a[0], a[0]), f_mul(a[1], a[1]))
+    ls = _f_pow_pat(norm, "ELEG")
+    b = a[0].shape[1]
+    return _f_eq_row(ls, f_one(b)) | _f_is_zero_row(norm)
+
+
+def fp2_sqrt_any(a):
+    """One root of a square input (garbage otherwise): a^((q+7)/16)
+    times the right fourth-root-of-unity candidate."""
+    b = a[0].shape[1]
+    tv = _fp2_pow_pat(a, "ESQRT")
+    out = tv
+    for cname in ("SQ_C1", "SQ_C2", "SQ_C3"):
+        cand = fp2_mul(tv, _fc2(cname, b))
+        good = _fp2_eq_row(fp2_sqr(cand), a)
+        out = (
+            jnp.where(good, cand[0], out[0]),
+            jnp.where(good, cand[1], out[1]),
+        )
+    return out
+
+
+def fp2_sgn0_row(a):
+    """RFC 9380 sgn0 for m=2: (1, B) int32 in {0, 1}."""
+    c0 = _from_mont(a[0])
+    c1 = _from_mont(a[1])
+    s0 = c0[0:1] & 1
+    z0 = jnp.all(c0 == 0, axis=0, keepdims=True).astype(jnp.int32)
+    s1 = c1[0:1] & 1
+    return s0 | (z0 & s1)
+
+
+def _fp2_sel(cond_row, x, y):
+    return (jnp.where(cond_row, x[0], y[0]),
+            jnp.where(cond_row, x[1], y[1]))
+
+
+# ---------------------------------------------------------------------------
+# SVDW map to the twist.
+# ---------------------------------------------------------------------------
+
+
+def _g_twist(x, b):
+    """g(x) = x³ + 4(1+u) on the twist."""
+    return fp2_add(fp2_mul(fp2_sqr(x), x), _fc2("H2C_B2", b))
+
+
+def map_to_curve_g2(u):
+    """SVDW map, straight-line (mirrors ops/h2c.py map_to_curve_g2)."""
+    b = u[0].shape[1]
+    one = fp2_one(b)
+    c2 = _fc2("H2C_C2", b)
+
+    tv1 = fp2_mul(fp2_sqr(u), _fc2("H2C_C1", b))
+    tv2 = fp2_add(one, tv1)
+    tv1 = fp2_sub(one, tv1)
+    tv3 = fp2_inv(fp2_mul(tv1, tv2))  # Fermat: inv(0) = 0
+    tv4 = fp2_mul(fp2_mul(fp2_mul(u, tv1), tv3), _fc2("H2C_C3", b))
+    x1 = fp2_sub(c2, tv4)
+    x2 = fp2_add(c2, tv4)
+    sq = fp2_sqr(fp2_mul(fp2_sqr(tv2), tv3))
+    x3 = fp2_add(fp2_mul(sq, _fc2("H2C_C4", b)), _fc2("H2C_Z", b))
+
+    e1 = fp2_is_square_row(_g_twist(x1, b))
+    e2 = fp2_is_square_row(_g_twist(x2, b))
+    x = _fp2_sel(e1, x1, _fp2_sel(e2, x2, x3))
+    y = fp2_sqrt_any(_g_twist(x, b))
+    flip = fp2_sgn0_row(u) != fp2_sgn0_row(y)
+    y = _fp2_sel(flip, fp2_neg(y), y)
+    return (x, y, one)
+
+
+# ---------------------------------------------------------------------------
+# psi + fast cofactor clearing (two-ladder form).
+# ---------------------------------------------------------------------------
+
+
+def g2_psi(p):
+    x, y, z = p
+    b = x[0].shape[1]
+    return (
+        fp2_mul(_fc2("PSI_CX", b), fp2_conj(x)),
+        fp2_mul(_fc2("PSI_CY", b), fp2_conj(y)),
+        fp2_conj(z),
+    )
+
+
+def point_neg2(p):
+    x, y, z = p
+    return (x, fp2_neg(y), z)
+
+
+def _pt_to_stack(p):
+    return jnp.concatenate(
+        [p[0][0], p[0][1], p[1][0], p[1][1], p[2][0], p[2][1]], axis=0
+    )
+
+
+def _stack_to_pt(s):
+    return (
+        (s[0 * NL : 1 * NL], s[1 * NL : 2 * NL]),
+        (s[2 * NL : 3 * NL], s[3 * NL : 4 * NL]),
+        (s[4 * NL : 5 * NL], s[5 * NL : 6 * NL]),
+    )
+
+
+def _mul_neg_x(p):
+    """[x]P for the negative BLS parameter (segment scan over |x|)."""
+    acc = _segment_scan(
+        p,
+        pp.MILLER_BITS,
+        sqr_step=point_double2,
+        mul_step=lambda q: point_add2(point_double2(q), p),
+        to_stack=_pt_to_stack,
+        from_stack=_stack_to_pt,
+    )
+    return point_neg2(acc)
+
+
+def clear_cofactor_g2(p):
+    """Two-ladder Budroni–Pintore (identical point to ops/h2c.py)."""
+    psip = g2_psi(p)
+    a = _mul_neg_x(p)
+    bq = _mul_neg_x(point_add2(a, psip))
+    acc = point_add2(bq, point_neg2(point_add2(a, p)))
+    acc = point_add2(acc, point_neg2(psip))
+    return point_add2(acc, g2_psi(g2_psi(point_double2(p))))
+
+
+def _to_affine2(p):
+    x, y, z = p
+    zi = fp2_inv(z)
+    return fp2_mul(x, zi), fp2_mul(y, zi)
+
+
+def _hash_point(u0, u1):
+    """(u0, u1) draws -> affine twist point ((x0,x1),(y0,y1))."""
+    q = point_add2(map_to_curve_g2(u0), map_to_curve_g2(u1))
+    return _to_affine2(clear_cofactor_g2(q))
+
+
+# ---------------------------------------------------------------------------
+# Kernels.
+# ---------------------------------------------------------------------------
+
+
+def _u_tuple(u_ref, k):
+    """Draw k (0 or 1) from the (4*NL, B) u rows."""
+    off = 2 * k * NL
+    return (u_ref[off : off + NL], u_ref[off + NL : off + 2 * NL])
+
+
+def _hash_kernel(consts_ref, u_ref, out_ref):
+    """u rows (4*NL, B) [u0.c0|u0.c1|u1.c0|u1.c1] -> affine point rows
+    (4*NL, B) [x.c0|x.c1|y.c0|y.c1]."""
+    pp._CTX["consts"] = consts_ref[:]
+    x, y = _hash_point(_u_tuple(u_ref, 0), _u_tuple(u_ref, 1))
+    out_ref[:] = jnp.concatenate([x[0], x[1], y[0], y[1]], axis=0)
+    pp._CTX.clear()
+
+
+def _check_hashed_kernel(consts_ref, p_ref, q_ref, u_ref, out_ref):
+    """End-to-end verify: Q2 = H(m) in-kernel, then the product check.
+
+    p_ref: (4*NL, B) G1 rows [p1.x|p1.y|p2.x|p2.y]
+    q_ref: (4*NL, B) G2 rows of Q1 (the signature)
+    u_ref: (4*NL, B) hash-to-field draws of the message
+    """
+    pp._CTX["consts"] = consts_ref[:]
+    b = p_ref.shape[-1]
+    q2 = _hash_point(_u_tuple(u_ref, 0), _u_tuple(u_ref, 1))
+    ok = pp._product_check(
+        p_ref[0 * NL : 1 * NL], p_ref[1 * NL : 2 * NL],
+        ((q_ref[0 * NL : 1 * NL], q_ref[1 * NL : 2 * NL]),
+         (q_ref[2 * NL : 3 * NL], q_ref[3 * NL : 4 * NL])),
+        p_ref[2 * NL : 3 * NL], p_ref[3 * NL : 4 * NL],
+        q2,
+        b,
+    )
+    out_ref[:] = jnp.broadcast_to(ok, (8, b)).astype(jnp.int32)
+    pp._CTX.clear()
+
+
+# ---------------------------------------------------------------------------
+# Host entries.
+# ---------------------------------------------------------------------------
+
+
+def _rows_fp2(u):
+    """(B, 2, NL) -> (2*NL, B)."""
+    n = u.shape[0]
+    return jnp.moveaxis(u, 0, -1).reshape(2 * NL, n)
+
+
+def _pad_batch(arrs, block):
+    bsz = arrs[0].shape[0]
+    pad = (-bsz) % block
+    if pad:
+        arrs = [
+            jnp.concatenate([x, jnp.repeat(x[:1], pad, axis=0)], axis=0)
+            for x in arrs
+        ]
+    return arrs, bsz
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def hash_to_g2(u0, u1, block: int = 128, interpret: bool = False):
+    """Batched device hash: field draws (B, 2, NL) Montgomery ->
+    affine G2 points (B, 2, 2, NL)."""
+    (u0, u1), bsz = _pad_batch([u0, u1], block)
+    n = u0.shape[0]
+    u_all = jnp.concatenate([_rows_fp2(u0), _rows_fp2(u1)], axis=0)
+    nconst = pp.CONSTS_NP.shape[0]
+    out = pl.pallas_call(
+        _hash_kernel,
+        out_shape=jax.ShapeDtypeStruct((4 * NL, n), jnp.int32),
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec(
+                (nconst, NL, 1), lambda i: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (4 * NL, block), lambda i: (0, i),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (4 * NL, block), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(jnp.asarray(pp.CONSTS_NP), u_all)
+    # (4*NL, n) -> (B, 2, 2, NL)
+    pts = jnp.moveaxis(out.reshape(2, 2, NL, n), -1, 0)
+    return pts[:bsz]
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def pairing_product_check_hashed(p1, q1, p2, u0, u1, block: int = 128,
+                                 interpret: bool = False):
+    """e(P1, Q1) · e(P2, H(u)) == 1 with the hash computed in-kernel.
+
+    p1/p2: (B, 2, NL) affine G1; q1: (B, 2, 2, NL) affine G2;
+    u0/u1: (B, 2, NL) hash-to-field draws.  Returns bool (B,).
+    """
+    (p1, q1, p2, u0, u1), bsz = _pad_batch([p1, q1, p2, u0, u1], block)
+    n = p1.shape[0]
+
+    def rows_g1(p):
+        return jnp.moveaxis(p, 0, -1).reshape(2 * NL, n)
+
+    def rows_g2(q):
+        return jnp.moveaxis(q, 0, -1).reshape(4 * NL, n)
+
+    p_all = jnp.concatenate([rows_g1(p1), rows_g1(p2)], axis=0)
+    q_all = rows_g2(q1)
+    u_all = jnp.concatenate([_rows_fp2(u0), _rows_fp2(u1)], axis=0)
+
+    nconst = pp.CONSTS_NP.shape[0]
+    out = pl.pallas_call(
+        _check_hashed_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, n), jnp.int32),
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec(
+                (nconst, NL, 1), lambda i: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (4 * NL, block), lambda i: (0, i),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (4 * NL, block), lambda i: (0, i),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (4 * NL, block), lambda i: (0, i),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (8, block), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(jnp.asarray(pp.CONSTS_NP), p_all, q_all, u_all)
+    return out[0, :bsz] != 0

@@ -68,9 +68,18 @@ def _pack_words(bits: np.ndarray):
 
 
 # only p-2 (Fermat inversion) still runs bit-by-bit; the Miller loop and
-# the final-exp pows consume their patterns as static segment structure
+# the final-exp pows consume their patterns as static segment structure.
+# ELEG/ESQRT are the hash-to-curve exponents (pallas_h2c.py): Legendre
+# (p-1)/2 on Fp, and the q = p^2 ≡ 9 (mod 16) sqrt exponent (q+7)/16.
 _BITS_PARTS = {
     "PM2": PM2BITS,
+    "ELEG": np.array(
+        [int(c) for c in bin((ref.P - 1) // 2)[2:]], dtype=np.int32
+    ),
+    "ESQRT": np.array(
+        [int(c) for c in bin((ref.P * ref.P + 7) // 16)[2:]],
+        dtype=np.int32,
+    ),
 }
 BIT_LEN = {name: len(arr) for name, arr in _BITS_PARTS.items()}
 BIT_WORDS = {name: _pack_words(arr) for name, arr in _BITS_PARTS.items()}
@@ -100,6 +109,26 @@ for _k in range(6):
     _CONSTS[f"G1P{_k}_0"] = _mont_limbs(_g[0])
     _CONSTS[f"G1P{_k}_1"] = _mont_limbs(_g[1])
     _CONSTS[f"G2P{_k}"] = _mont_limbs(pow(ref._GAMMA2, _k, ref.P))
+
+# hash-to-curve constants (pallas_h2c.py): SVDW map for the twist, psi
+# endomorphism, and the q ≡ 9 (mod 16) sqrt candidates — all derived from
+# the oracle, same values ops/h2c.py uses
+def _reg_fp2(name: str, v) -> None:
+    _CONSTS[f"{name}_0"] = _mont_limbs(v[0])
+    _CONSTS[f"{name}_1"] = _mont_limbs(v[1])
+
+
+_reg_fp2("H2C_Z", ref.SVDW_G2.Z)
+_reg_fp2("H2C_C1", ref.SVDW_G2.c1)
+_reg_fp2("H2C_C2", ref.SVDW_G2.c2)
+_reg_fp2("H2C_C3", ref.SVDW_G2.c3)
+_reg_fp2("H2C_C4", ref.SVDW_G2.c4)
+_reg_fp2("H2C_B2", ref.B2)
+_reg_fp2("PSI_CX", ref.PSI_CX)
+_reg_fp2("PSI_CY", ref.PSI_CY)
+_reg_fp2("SQ_C1", (0, 1))
+_reg_fp2("SQ_C2", ref.fp2_sqrt((0, 1)))
+_reg_fp2("SQ_C3", ref.fp2_sqrt((0, ref.P - 1)))
 
 _CONST_ORDER = list(_CONSTS.keys())
 #: (K, NL, 1) int32 — constants indexed on the LEADING dim so in-kernel
@@ -630,6 +659,10 @@ class _PRec:
             else:
                 nneg += abs(cf)
                 neg = term if neg is None else neg + term
+        if pos is None:
+            # invariant today: >= 1 positive term per output; keep an
+            # all-negative combination trace-safe (see tower.materialize)
+            pos = jnp.zeros_like(self.wides[next(iter(sym.c))])
         acc = pos
         if neg is not None:
             acc = acc - neg + _w_sub_col() * nneg
@@ -1025,34 +1058,15 @@ def _miller(px, py, xq, yq, b):
     return fp12_conj(state[0])  # x < 0
 
 
-def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
-    """Batched product check over one block.
+def _product_check(p1x, p1y, q1, p2x, p2y, q2, b):
+    """Core check e(P1,Q1)·e(P2,Q2)==1 on in-kernel values.
 
-    consts_ref: (K, NL, 1) VMEM — limb constants (leading-dim indexed)
-    p_ref: (4 * NL, B)   G1 affine rows [p1.x | p1.y | p2.x | p2.y]
-    q_ref: (8 * NL, B)   G2 affine rows [q1.x.c0 | q1.x.c1 | q1.y.c0 |
-                         q1.y.c1 | q2...]
-    out_ref: (8, B) int32 — row 0 holds the verdict (padded to the int32
-                         min sublane tile).
-
-    The two Miller loops run sequentially on single-width batches —
-    doubling lanes and splitting mid-kernel trips Mosaic layout bugs.
+    q1/q2: ((x0, x1), (y0, y1)) affine twist coords.  Returns the (1, B)
+    bool verdict row.  Shared by the plain kernel and the hashed-input
+    kernel (pallas_h2c.py), which computes Q2 = H(m) in-kernel first.
     """
-    _CTX["consts"] = consts_ref[:]
-
-    b = p_ref.shape[-1]
-    f1 = _miller(
-        p_ref[0 * NL : 1 * NL], p_ref[1 * NL : 2 * NL],
-        (q_ref[0 * NL : 1 * NL], q_ref[1 * NL : 2 * NL]),
-        (q_ref[2 * NL : 3 * NL], q_ref[3 * NL : 4 * NL]),
-        b,
-    )
-    f2 = _miller(
-        p_ref[2 * NL : 3 * NL], p_ref[3 * NL : 4 * NL],
-        (q_ref[4 * NL : 5 * NL], q_ref[5 * NL : 6 * NL]),
-        (q_ref[6 * NL : 7 * NL], q_ref[7 * NL : 8 * NL]),
-        b,
-    )
+    f1 = _miller(p1x, p1y, q1[0], q1[1], b)
+    f2 = _miller(p2x, p2y, q2[0], q2[1], b)
     g = fp12_mul_lazy(f1, f2)
 
     # final exponentiation (cubed; see ops/pairing.py)
@@ -1080,6 +1094,34 @@ def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
                     v = jnp.concatenate([v[0:1] - 1, v[1:]], axis=0)
                     first = False
                 ok = ok & jnp.all(v == 0, axis=0, keepdims=True)
+    return ok
+
+
+def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
+    """Batched product check over one block.
+
+    consts_ref: (K, NL, 1) VMEM — limb constants (leading-dim indexed)
+    p_ref: (4 * NL, B)   G1 affine rows [p1.x | p1.y | p2.x | p2.y]
+    q_ref: (8 * NL, B)   G2 affine rows [q1.x.c0 | q1.x.c1 | q1.y.c0 |
+                         q1.y.c1 | q2...]
+    out_ref: (8, B) int32 — row 0 holds the verdict (padded to the int32
+                         min sublane tile).
+
+    The two Miller loops run sequentially on single-width batches —
+    doubling lanes and splitting mid-kernel trips Mosaic layout bugs.
+    """
+    _CTX["consts"] = consts_ref[:]
+
+    b = p_ref.shape[-1]
+    ok = _product_check(
+        p_ref[0 * NL : 1 * NL], p_ref[1 * NL : 2 * NL],
+        ((q_ref[0 * NL : 1 * NL], q_ref[1 * NL : 2 * NL]),
+         (q_ref[2 * NL : 3 * NL], q_ref[3 * NL : 4 * NL])),
+        p_ref[2 * NL : 3 * NL], p_ref[3 * NL : 4 * NL],
+        ((q_ref[4 * NL : 5 * NL], q_ref[5 * NL : 6 * NL]),
+         (q_ref[6 * NL : 7 * NL], q_ref[7 * NL : 8 * NL])),
+        b,
+    )
     out_ref[:] = jnp.broadcast_to(ok, (8, b)).astype(jnp.int32)
     _CTX.clear()
 

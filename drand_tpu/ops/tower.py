@@ -556,6 +556,13 @@ class _Rec:
                 else:
                     nneg += abs(cf)
                     neg = term if neg is None else neg + term
+            if pos is None:
+                # invariant today: every symbolic output has >= 1 positive
+                # term; start from zeros so an all-negative combination
+                # from a future lazy formula reduces correctly instead of
+                # crashing at trace time
+                pos = jnp.zeros(wide.shape[:-2] + wide.shape[-1:],
+                                dtype=wide.dtype)
             acc = pos
             if neg is not None:
                 acc = acc - neg + jnp.asarray(fp.W_SUB) * nneg
@@ -799,6 +806,13 @@ def fp2_encode(c: "ref.Fp2"):
 def fp2_decode(a) -> "ref.Fp2":
     c = np.asarray(fp.canon(a))
     return (fp.limbs_to_int(c[..., 0, :]), fp.limbs_to_int(c[..., 1, :]))
+
+
+def fp2_encode_batch(vals) -> jnp.ndarray:
+    """Many oracle Fp2 tuples -> (B, 2, NLIMB) Montgomery limbs in ONE
+    device dispatch (see fp.encode_batch)."""
+    flat = [c for v in vals for c in (v[0], v[1])]
+    return fp.encode_batch(flat).reshape(len(vals), 2, fp.NLIMB)
 
 
 def fp6_encode(c: "ref.Fp6"):

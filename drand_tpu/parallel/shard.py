@@ -118,5 +118,14 @@ def sharded_msm(mesh: Mesh, points, bits, F: FieldOps = F2):
     shard = batch_sharding(mesh)
     points = jax.device_put(points, shard)
     bits = jax.device_put(bits, shard)
-    fn = jax.jit(partial(_sharded_msm, mesh=mesh, F=F))
+    key = (mesh, F.name)
+    fn = _MSM_CACHE.get(key)
+    if fn is None:
+        # jit caches by function identity — a fresh partial per call
+        # would recompile every invocation
+        fn = jax.jit(partial(_sharded_msm, mesh=mesh, F=F))
+        _MSM_CACHE[key] = fn
     return fn(points, bits)
+
+
+_MSM_CACHE: dict = {}

@@ -1,0 +1,120 @@
+"""Structured logfmt logging with bound fields.
+
+The reference threads a leveled go-kit logfmt logger through every
+handler, binding contextual fields once and emitting machine-parseable
+key=value lines (/root/reference/log/log.go:12, bound e.g. at
+beacon/beacon.go:91, dkg/dkg.go:159).  This is the same shape over the
+stdlib: `get_logger("beacon").bind(node=3)` returns a logger whose every
+line carries `node=3`, and per-call keywords add more fields:
+
+    log = get_logger("beacon").bind(node=3)
+    log.info("round stored", round=42)
+    # ts=2026-07-30T12:00:00Z level=info logger=beacon node=3 round=42
+    #   msg="round stored"
+
+Plain stdlib handlers/levels still apply (the formatter is installed on
+the package root, so operators can re-route or silence as usual).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict
+
+_ROOT = "drand_tpu"
+
+
+def _quote(v: Any) -> str:
+    s = str(v)
+    if s == "" or any(c in s for c in ' ="'):
+        return '"' + s.replace('\\', '\\\\').replace('"', '\\"') + '"'
+    return s
+
+
+class LogfmtFormatter(logging.Formatter):
+    """ts=... level=... logger=... <bound+call fields> msg="..."."""
+
+    converter = time.gmtime
+
+    def format(self, record: logging.LogRecord) -> str:
+        ts = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", self.converter(record.created)
+        )
+        parts = [
+            f"ts={ts}",
+            f"level={record.levelname.lower()}",
+            f"logger={record.name.removeprefix(_ROOT + '.')}",
+        ]
+        fields: Dict[str, Any] = getattr(record, "logfmt_fields", None) or {}
+        parts.extend(f"{k}={_quote(v)}" for k, v in fields.items())
+        parts.append(f"msg={_quote(record.getMessage())}")
+        if record.exc_info:
+            exc = self.formatException(record.exc_info)
+            parts.append(f"exc={_quote(exc.splitlines()[-1])}")
+        return " ".join(parts)
+
+
+class BoundLogger:
+    """Immutable field-carrying wrapper; .bind() layers more fields."""
+
+    __slots__ = ("_logger", "_fields")
+
+    def __init__(self, logger: logging.Logger,
+                 fields: Dict[str, Any] | None = None):
+        self._logger = logger
+        self._fields = dict(fields or {})
+
+    def bind(self, **fields: Any) -> "BoundLogger":
+        merged = dict(self._fields)
+        merged.update(fields)
+        return BoundLogger(self._logger, merged)
+
+    def _log(self, level: int, msg: str, exc_info=None,
+             **fields: Any) -> None:
+        if not self._logger.isEnabledFor(level):
+            return
+        merged = dict(self._fields)
+        merged.update(fields)
+        self._logger.log(
+            level, msg, exc_info=exc_info,
+            extra={"logfmt_fields": merged},
+        )
+
+    def debug(self, msg: str, **f: Any) -> None:
+        self._log(logging.DEBUG, msg, **f)
+
+    def info(self, msg: str, **f: Any) -> None:
+        self._log(logging.INFO, msg, **f)
+
+    def warning(self, msg: str, **f: Any) -> None:
+        self._log(logging.WARNING, msg, **f)
+
+    def error(self, msg: str, **f: Any) -> None:
+        self._log(logging.ERROR, msg, **f)
+
+    def exception(self, msg: str, **f: Any) -> None:
+        self._log(logging.ERROR, msg, exc_info=True, **f)
+
+
+_configured = False
+
+
+def setup(level: int = logging.INFO, force: bool = False) -> None:
+    """Install the logfmt formatter on the package root logger (idempotent;
+    daemons call this at boot, tests/libraries may skip it entirely)."""
+    global _configured
+    if _configured and not force:
+        return
+    root = logging.getLogger(_ROOT)
+    handler = logging.StreamHandler()
+    handler.setFormatter(LogfmtFormatter())
+    root.addHandler(handler)
+    root.setLevel(level)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str, **fields: Any) -> BoundLogger:
+    """Bound logfmt logger under the drand_tpu namespace."""
+    return BoundLogger(logging.getLogger(f"{_ROOT}.{name}"), fields)

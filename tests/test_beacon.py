@@ -305,3 +305,37 @@ async def test_round_window_rejects_stale_packets():
     with pytest.raises(ValueError):
         await h.process_beacon(pkt)
     await h.stop()
+
+
+@pytest.mark.asyncio
+async def test_round_manager_resent_partial_after_desync():
+    """A partial with a mismatched chain link must NOT consume the
+    signer's dedup slot: after the peer resyncs and re-sends a matching
+    partial, it still counts toward the round (ADVICE r1 finding)."""
+    from drand_tpu.beacon.round_cache import RoundManager
+
+    def index_of(blob):
+        return blob[0]
+
+    mgr = RoundManager(index_of)
+    queue = mgr.new_round(10, 9, b"good-link")
+
+    # desynced peer 2: wrong prev link -> dropped silently
+    mgr.add_partial(10, bytes([2]) + b"stale", 8, b"old-link")
+    assert queue.qsize() == 0
+
+    # peer 2 resyncs and re-sends the corrected partial -> accepted
+    mgr.add_partial(10, bytes([2]) + b"fresh", 9, b"good-link")
+    assert queue.qsize() == 1
+
+    # but a true duplicate is still deduped
+    mgr.add_partial(10, bytes([2]) + b"fresh", 9, b"good-link")
+    assert queue.qsize() == 1
+
+    # look-ahead buffered partials are link-checked on flush too
+    mgr.add_partial(11, bytes([3]) + b"early-bad", 9, b"wrong")
+    mgr.add_partial(11, bytes([4]) + b"early-good", 10, b"next-link")
+    q2 = mgr.new_round(11, 10, b"next-link")
+    assert q2.qsize() == 1
+    blob, pr, ps = q2.get_nowait()
+    assert blob[0] == 4 and (pr, ps) == (10, b"next-link")

@@ -77,17 +77,39 @@ def test_engine_fresh_dkg_produces_consistent_key():
 
 
 def test_engine_rejects_tampered_deal():
+    from drand_tpu.crypto import schnorr
+
     pairs = make_pairs(4, 22)
     nodes = [p.public for p in pairs]
     g0 = DistKeyGenerator(pair=pairs[0], participants=nodes, threshold=3)
     g1 = DistKeyGenerator(pair=pairs[1], participants=nodes, threshold=3)
     deal = [d for d in g0.deals() if d.recipient_index == 1][0]
-    bad = Deal(
+    # tampered ciphertext WITHOUT a re-sign: the signature check drops it
+    # outright (never answered with a complaint — see Deal docstring)
+    forged = Deal(
         dealer_index=deal.dealer_index,
         recipient_index=deal.recipient_index,
         commits_bytes=deal.commits_bytes,
         encrypted_share=deal.encrypted_share[:-1]
         + bytes([deal.encrypted_share[-1] ^ 1]),
+        signature=deal.signature,
+    )
+    with pytest.raises(DKGError, match="signature"):
+        g1.process_deal(forged)
+    # a malicious dealer SIGNING its garbage gets a complaint instead
+    bad = Deal(
+        dealer_index=forged.dealer_index,
+        recipient_index=forged.recipient_index,
+        commits_bytes=forged.commits_bytes,
+        encrypted_share=forged.encrypted_share,
+    )
+    bad = Deal(
+        dealer_index=bad.dealer_index,
+        recipient_index=bad.recipient_index,
+        commits_bytes=bad.commits_bytes,
+        encrypted_share=bad.encrypted_share,
+        signature=schnorr.sign(
+            pairs[0].private, bad.signed_payload(b"")),
     )
     resp = g1.process_deal(bad)
     assert not resp.approved
@@ -279,3 +301,253 @@ def test_ecies_roundtrip_and_tamper():
     bad = blob[:-1] + bytes([blob[-1] ^ 1])
     with pytest.raises(ecies.EciesError):
         ecies.decrypt(pair.private, bad, b"ctx")
+
+
+# -- justification round (kyber vss semantics, vss.proto:60-69) ------------
+
+
+def test_engine_false_complaint_neutralized_by_justification():
+    """A lying verifier's bare complaint must not knock an honest dealer
+    out of QUAL: the dealer justifies, everyone re-verifies, and the
+    complaint flips into an approval."""
+    from drand_tpu.dkg import Response
+
+    pairs = make_pairs(4, 41)
+    nodes = [p.public for p in pairs]
+    t = 3
+    gens = [
+        DistKeyGenerator(pair=p, participants=nodes, threshold=t)
+        for p in pairs
+    ]
+    responses = []
+    for g in gens:
+        for deal in g.deals():
+            resp = gens[deal.recipient_index].process_deal(deal)
+            if deal.dealer_index == 0 and deal.recipient_index == 1:
+                # verifier 1 LIES: broadcasts a (validly signed)
+                # complaint about a valid deal
+                from drand_tpu.crypto import schnorr
+
+                lie = Response(dealer_index=0, verifier_index=1,
+                               approved=False)
+                resp = Response(
+                    dealer_index=0, verifier_index=1, approved=False,
+                    signature=schnorr.sign(
+                        pairs[1].private, lie.signed_payload(b"")
+                    ),
+                )
+            responses.append(resp)
+    for g in gens:
+        for resp in responses:
+            if resp.verifier_index != g.index:
+                g.process_response(resp)
+
+    # the lie blocks certification of dealer 0 on honest nodes
+    assert not gens[0].certified()
+    assert 0 not in gens[0].qual()
+
+    lie = [r for r in responses if not r.approved][0]
+    pending = gens[0].pending_complaints()
+    assert [(c.dealer_index, c.verifier_index, c.approved)
+            for c in pending] == [(0, 1, False)]
+    just = gens[0].justify(pending[0])
+    for g in gens:
+        g.process_justification(just)
+
+    # complaint answered: dealer 0 back in QUAL, full certification
+    assert all(0 in g.qual() for g in gens)
+    assert all(g.certified() for g in gens)
+    shares = [g.dist_key_share() for g in gens]
+    secret = recover_secret([s.share for s in shares[:t]], t)
+    assert ref.g1_mul(ref.G1_GEN, secret) == shares[0].commits[0]
+    # the dealer does not answer the same complaint twice
+    assert gens[0].pending_complaints() == []
+
+
+def test_engine_invalid_justification_exposes_dealer():
+    """A dealer that answers a genuine complaint with a validly-signed
+    but WRONG justification is provably cheating: excluded from QUAL
+    everywhere, regardless of how many approvals it had."""
+    from drand_tpu.crypto import schnorr
+    from drand_tpu.dkg import Justification, Response
+
+    pairs = make_pairs(4, 42)
+    nodes = [p.public for p in pairs]
+    t = 3
+    gens = [
+        DistKeyGenerator(pair=p, participants=nodes, threshold=t)
+        for p in pairs
+    ]
+    responses = []
+    for g in gens:
+        for deal in g.deals():
+            resp = gens[deal.recipient_index].process_deal(deal)
+            if deal.dealer_index == 0 and deal.recipient_index == 1:
+                # verifier 1 complains about dealer 0 from the start
+                lie = Response(dealer_index=0, verifier_index=1,
+                               approved=False)
+                resp = Response(
+                    dealer_index=0, verifier_index=1, approved=False,
+                    signature=schnorr.sign(
+                        pairs[1].private, lie.signed_payload(b"")),
+                )
+            responses.append(resp)
+    for g in gens:
+        for resp in responses:
+            if resp.verifier_index != g.index:
+                g.process_response(resp)
+
+    honest = gens[0].justify(
+        Response(dealer_index=0, verifier_index=1, approved=False)
+    )
+
+    # an UNSIGNED forged justification is dropped and convicts nobody
+    unsigned = Justification(
+        dealer_index=0, verifier_index=1,
+        share_value=(honest.share_value + 1) % ref.R,
+        commits_bytes=honest.commits_bytes,
+    )
+    with pytest.raises(DKGError, match="signature"):
+        gens[1].process_justification(unsigned)
+    assert 0 not in gens[1]._bad_dealers
+
+    # the MALICIOUS DEALER signing a wrong sub-share convicts itself
+    body = Justification(
+        dealer_index=0,
+        verifier_index=1,
+        share_value=(honest.share_value + 1) % ref.R,  # wrong sub-share
+        commits_bytes=honest.commits_bytes,
+    )
+    forged = Justification(
+        dealer_index=0, verifier_index=1,
+        share_value=body.share_value,
+        commits_bytes=body.commits_bytes,
+        signature=schnorr.sign(
+            pairs[0].private, body.signed_payload(b"")),
+    )
+    for g in gens[1:]:
+        g.process_justification(forged)
+    for g in gens[1:]:
+        assert 0 not in g.qual()
+        assert not g.certified()
+        # the other three dealers still carry the DKG (3 >= t)
+        assert g.threshold_certified()
+    shares = [g.dist_key_share() for g in gens[1:]]
+    secret = recover_secret([s.share for s in shares[:t]], t)
+    assert ref.g1_mul(ref.G1_GEN, secret) == shares[0].commits[0]
+
+
+def test_engine_justification_delivers_share_to_complainer():
+    """A complainer whose deal was genuinely undecryptable adopts the
+    revealed sub-share from a valid justification, so the dealer's QUAL
+    membership stays usable for the final share computation."""
+    pairs = make_pairs(4, 43)
+    nodes = [p.public for p in pairs]
+    t = 3
+    gens = [
+        DistKeyGenerator(pair=p, participants=nodes, threshold=t)
+        for p in pairs
+    ]
+    responses = []
+    for g in gens:
+        for deal in g.deals():
+            if g is gens[0] and deal.recipient_index == 1:
+                # dealer 0 garbles node 1's ciphertext (and signs the
+                # garbage — an authentic-but-broken deal)
+                from drand_tpu.crypto import schnorr
+
+                deal = Deal(
+                    dealer_index=deal.dealer_index,
+                    recipient_index=deal.recipient_index,
+                    commits_bytes=deal.commits_bytes,
+                    encrypted_share=deal.encrypted_share[:-1]
+                    + bytes([deal.encrypted_share[-1] ^ 1]),
+                )
+                deal = Deal(
+                    dealer_index=deal.dealer_index,
+                    recipient_index=deal.recipient_index,
+                    commits_bytes=deal.commits_bytes,
+                    encrypted_share=deal.encrypted_share,
+                    signature=schnorr.sign(
+                        pairs[0].private, deal.signed_payload(b"")),
+                )
+            responses.append(gens[deal.recipient_index].process_deal(deal))
+    complaints = [r for r in responses if not r.approved]
+    assert [(c.dealer_index, c.verifier_index, c.approved)
+            for c in complaints] == [(0, 1, False)]
+    for g in gens:
+        for resp in responses:
+            if resp.verifier_index != g.index:
+                g.process_response(resp)
+    # dealer 0 answers; node 1 adopts the revealed share
+    just = gens[0].justify(complaints[0])
+    for g in gens:
+        g.process_justification(just)
+    assert all(g.certified() for g in gens)
+    shares = [g.dist_key_share() for g in gens]
+    secret = recover_secret([s.share for s in shares[:t]], t)
+    assert ref.g1_mul(ref.G1_GEN, secret) == shares[0].commits[0]
+
+
+def test_justification_wire_roundtrip():
+    from drand_tpu.dkg import Justification
+
+    j = Justification(
+        dealer_index=2, verifier_index=3,
+        share_value=0xABCDEF0123456789,
+        commits_bytes=(b"\x01" * 48, b"\x02" * 48),
+    )
+    assert Justification.from_dict(j.to_dict()) == j
+
+
+@pytest.mark.asyncio
+async def test_handler_false_complaint_resolved_without_timeout():
+    """End-to-end over the loopback net: one node lies about dealer 0;
+    the justification round restores full certification, so every node
+    finishes WITHOUT the timeout path."""
+    from drand_tpu.dkg import Response
+
+    pairs = make_pairs(4, 44)
+    clock = FakeClock()
+    group = Group(nodes=[p.public for p in pairs], threshold=3,
+                  genesis_time=int(clock.now()) + 1000)
+    net = DKGNet()
+    handlers = []
+    for p in pairs:
+        h = DKGHandler(
+            DKGConfig(pair=p, new_group=group, clock=clock, timeout=3600),
+            net,
+        )
+        net.register(p.public.address, h)
+        handlers.append(h)
+
+    liar = handlers[1]
+    orig = liar.dkg.process_deal
+    session = group.hash()
+
+    def lying_process_deal(deal):
+        from drand_tpu.crypto import schnorr
+
+        resp = orig(deal)
+        if deal.dealer_index == 0:
+            lie = Response(dealer_index=0,
+                           verifier_index=resp.verifier_index,
+                           approved=False)
+            resp = Response(
+                dealer_index=0, verifier_index=resp.verifier_index,
+                approved=False,
+                signature=schnorr.sign(
+                    liar.cfg.pair.private, lie.signed_payload(session)
+                ),
+            )
+        return resp
+
+    liar.dkg.process_deal = lying_process_deal
+
+    futs = await drive_dkg(handlers)
+    # NO clock.advance: completion proves justification, not timeout
+    shares = [await asyncio.wait_for(f, 10) for f in futs]
+    assert all(s is not None for s in shares)
+    assert all(0 in h.dkg.qual() for h in handlers)
+    secret = recover_secret([s.share for s in shares[:3]], 3)
+    assert ref.g1_mul(ref.G1_GEN, secret) == shares[0].commits[0]

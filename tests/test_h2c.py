@@ -1,0 +1,98 @@
+"""Device hash-to-curve (ops/h2c.py) vs the pure-Python oracle.
+
+Reference behavior: kyber hashes every signed message into G2
+(/root/reference/key/curve.go:30); here the map + cofactor clearing run
+batched on device and must agree bit-for-bit with refimpl.hash_to_g2.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.ops import curve, h2c, tower
+
+B = 4  # batch size shared across tests to bound XLA compiles
+
+
+def _decode_affine(row):
+    return (tower.fp2_decode(row[0]), tower.fp2_decode(row[1]))
+
+
+def test_fp2_sqrt_and_is_square():
+    rng = np.random.default_rng(7)
+    vals = []
+    for i in range(B):
+        a = (int(rng.integers(1 << 62)) * 0x9E3779B97F4A7C15 + i) % ref.P
+        b = int(rng.integers(1 << 62)) % ref.P
+        vals.append((a, b))
+    squares = [ref.fp2_sqr(v) for v in vals]
+    enc_sq = jnp.stack([tower.fp2_encode(s) for s in squares])
+    enc_raw = jnp.stack([tower.fp2_encode(v) for v in vals])
+
+    is_sq = np.asarray(h2c.fp2_is_square(enc_sq))
+    assert is_sq.all()
+    want = [ref.fp2_is_square(v) for v in vals]
+    got = np.asarray(h2c.fp2_is_square(enc_raw))
+    assert list(got) == want
+
+    roots = np.asarray(h2c.fp2_sqrt_any(enc_sq))
+    for i in range(B):
+        r = tower.fp2_decode(roots[i])
+        assert ref.fp2_sqr(r) == squares[i]
+
+
+def test_map_to_curve_parity():
+    msgs = [b"map-%d" % i for i in range(B)]
+    draws = [ref.hash_to_field_fp2(m, 2, ref.DST_G2) for m in msgs]
+    u0 = jnp.stack([tower.fp2_encode(d[0]) for d in draws])
+    got = np.asarray(h2c.map_to_curve_g2(u0))
+    for i in range(B):
+        want = ref.SVDW_G2.map_to_curve(draws[i][0])
+        assert _decode_affine(got[i]) == want
+
+
+def test_map_to_curve_zero_input():
+    """u = 0 exercises the exceptional inv0 path branchlessly."""
+    u0 = jnp.stack([tower.fp2_encode((0, 0)) for _ in range(B)])
+    got = np.asarray(h2c.map_to_curve_g2(u0))
+    want = ref.SVDW_G2.map_to_curve((0, 0))
+    for i in range(B):
+        assert _decode_affine(got[i]) == want
+        assert ref.g2_is_on_curve(want)
+
+
+def test_psi_and_clear_cofactor_parity():
+    pts = [ref.g2_mul(ref.G2_GEN, 777 + 13 * i) for i in range(B)]
+    enc = jnp.stack([curve.g2_encode(p) for p in pts])
+
+    psi_dev = np.asarray(h2c.g2_psi(enc))
+    for i in range(B):
+        assert curve.g2_decode(psi_dev[i]) == ref.g2_psi(pts[i])
+
+    cc = np.asarray(h2c.clear_cofactor_g2(enc))
+    for i in range(B):
+        assert curve.g2_decode(cc[i]) == ref.g2_clear_cofactor(pts[i])
+
+
+def test_hash_to_g2_batch_parity_and_subgroup():
+    msgs = [b"drand-tpu round %d" % i for i in range(B)]
+    out = np.asarray(h2c.hash_to_g2_batch(msgs))
+    for i, m in enumerate(msgs):
+        got = _decode_affine(out[i])
+        assert got == ref.hash_to_g2(m)
+        assert ref.g2_is_on_curve(got)
+        assert ref.ec_mul(ref.FP2_OPS, got, ref.R) is None
+
+    # deterministic: same message, same point; distinct messages differ
+    again = np.asarray(h2c.hash_to_g2_batch(msgs))
+    assert (again == out).all()
+    assert _decode_affine(out[0]) != _decode_affine(out[1])
+
+
+def test_hash_to_g2_proj_matches_affine():
+    msgs = [b"proj-%d" % i for i in range(B)]
+    proj = h2c.hash_to_g2_batch_proj(msgs)
+    aff = np.asarray(h2c.hash_to_g2_batch(msgs))
+    for i in range(B):
+        assert curve.g2_decode(np.asarray(proj[i])) == _decode_affine(aff[i])

@@ -1,0 +1,100 @@
+"""Structured logfmt logging (reference: go-kit logfmt logger with bound
+contextual fields, /root/reference/log/log.go:12)."""
+
+import logging
+
+from drand_tpu.utils.logging import BoundLogger, LogfmtFormatter, get_logger
+
+
+def _capture(logger_name="drand_tpu.testlog"):
+    records = []
+
+    class H(logging.Handler):
+        def emit(self, record):
+            records.append(LogfmtFormatter().format(record))
+
+    lg = logging.getLogger(logger_name)
+    lg.setLevel(logging.DEBUG)
+    lg.propagate = False
+    h = H()
+    lg.addHandler(h)
+    return records, lg, h
+
+
+def test_bound_fields_and_formatting():
+    records, lg, h = _capture()
+    try:
+        log = BoundLogger(lg).bind(node=3, addr="127.0.0.1:8080")
+        log.info("round stored", round=42)
+        line = records[-1]
+        assert "level=info" in line
+        assert "node=3" in line
+        assert "addr=127.0.0.1:8080" in line
+        assert "round=42" in line
+        assert 'msg="round stored"' in line
+        # every token is key=value (machine parseable)
+        for tok in _split_logfmt(line):
+            assert "=" in tok, tok
+    finally:
+        lg.removeHandler(h)
+
+
+def _split_logfmt(line):
+    """Split on spaces outside double quotes."""
+    out, cur, inq = [], "", False
+    for c in line:
+        if c == '"':
+            inq = not inq
+        if c == " " and not inq:
+            out.append(cur)
+            cur = ""
+        else:
+            cur += c
+    if cur:
+        out.append(cur)
+    return out
+
+
+def test_quoting_and_bind_layering():
+    records, lg, h = _capture("drand_tpu.testlog2")
+    try:
+        base = BoundLogger(lg).bind(a=1)
+        child = base.bind(b='has "quotes" and spaces')
+        child.warning("msg with spaces", c="x=y")
+        line = records[-1]
+        assert "a=1" in line
+        assert 'b="has \\"quotes\\" and spaces"' in line
+        assert 'c="x=y"' in line
+        # bind() is immutable: the parent did not gain b
+        base.info("second")
+        assert "b=" not in records[-1]
+    finally:
+        lg.removeHandler(h)
+
+
+def test_get_logger_namespace():
+    log = get_logger("beacon", node=1)
+    assert isinstance(log, BoundLogger)
+    records, lg, h = _capture("drand_tpu.beacon")
+    try:
+        log.debug("hello")
+        assert "logger=beacon" in records[-1]
+        assert "node=1" in records[-1]
+    finally:
+        lg.removeHandler(h)
+
+
+def test_exception_line():
+    records, lg, h = _capture("drand_tpu.testlog3")
+    try:
+        log = BoundLogger(lg)
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            log.exception("round failed", round=7)
+        line = records[-1]
+        assert "level=error" in line
+        assert "round=7" in line
+        assert "boom" in line
+    finally:
+        lg.removeHandler(h)

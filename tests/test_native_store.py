@@ -110,3 +110,18 @@ def test_empty_store_lookups(tmp_path):
     assert st.cursor().next() is None
     assert st.range_from(0) == []
     st.close()
+
+
+def test_single_writer_lock(tmp_path):
+    """A second open of the same log must fail while the first holds it
+    (the reference's boltdb flocks its DB the same way)."""
+    path = str(tmp_path / "locked.db")
+    st = NativeBeaconStore(path)
+    fill(st, range(3))
+    with pytest.raises(RuntimeError):
+        NativeBeaconStore(path)
+    st.close()
+    # released on close: reopening now works and sees the data
+    st2 = NativeBeaconStore(path)
+    assert len(st2) == 3
+    st2.close()

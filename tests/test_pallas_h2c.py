@@ -1,0 +1,191 @@
+"""Pallas hash-to-curve building blocks vs the oracle (interpreter mode).
+
+Same compositional strategy as test_pallas.py: the full hashed-check
+kernel runs on real TPU (bench.py), while every layer it is built from —
+Legendre test, q ≡ 9 (mod 16) sqrt, sgn0, the SVDW map, psi, the x-ladder
+and the two-ladder cofactor clearing — is checked against
+refimpl.hash_to_g2's identical formulas here.
+"""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.ops import fp
+from drand_tpu.ops import pallas_h2c as ph
+from drand_tpu.ops import pallas_pairing as pp
+
+rng = random.Random(0x42C2)
+B = 4
+NL = pp.NL
+
+
+def col(x: int) -> np.ndarray:
+    return fp.int_to_limbs(x * fp.R_MONT % ref.P)
+
+
+def decode(limb_col) -> int:
+    return fp.limbs_to_int(np.asarray(limb_col)) % ref.P
+
+
+def pack2(vals):
+    """List of oracle Fp2 -> (2*NL, B) rows."""
+    return jnp.asarray(np.concatenate(
+        [np.stack([col(v[0]) for v in vals], axis=1),
+         np.stack([col(v[1]) for v in vals], axis=1)], axis=0
+    ))
+
+
+def unpack2(arr, i):
+    rinv = pow(fp.R_MONT, -1, ref.P)
+    return (decode(arr[:NL, i]) * rinv % ref.P,
+            decode(arr[NL:, i]) * rinv % ref.P)
+
+
+def run_rows(fn, out_rows, *arrays):
+    def kern(consts_ref, *refs):
+        out_ref = refs[-1]
+        ins = [r[:] for r in refs[:-1]]
+        pp._CTX["consts"] = consts_ref[:]
+        out_ref[:] = fn(*ins)
+        pp._CTX.clear()
+
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((out_rows, B), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)]
+        * (1 + len(arrays)),
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=True,
+    )(jnp.asarray(pp.CONSTS_NP), *arrays)
+
+
+def _t2(u):
+    return (u[:NL], u[NL:])
+
+
+def test_is_square_sqrt_sgn0_vs_oracle():
+    vals = [(rng.randrange(ref.P), rng.randrange(ref.P)) for _ in range(2)]
+    squares = [ref.fp2_sqr(v) for v in vals]
+    mixed = squares + vals  # 2 guaranteed squares + 2 random
+
+    def kis(u):
+        return jnp.broadcast_to(
+            ph.fp2_is_square_row(_t2(u)).astype(jnp.int32), (8, B)
+        )
+
+    out = np.asarray(run_rows(kis, 8, pack2(mixed)))[0]
+    want = [ref.fp2_is_square(v) for v in mixed]
+    assert [bool(x) for x in out] == want
+
+    def ksqrt(u):
+        r = ph.fp2_sqrt_any(_t2(u))
+        return jnp.concatenate(r, axis=0)
+
+    out = np.asarray(run_rows(ksqrt, 2 * NL, pack2(squares + squares)))
+    for i in range(2):
+        got = unpack2(out, i)
+        assert ref.fp2_sqr(got) == squares[i]
+
+    def ksgn(u):
+        return jnp.broadcast_to(ph.fp2_sgn0_row(_t2(u)), (8, B))
+
+    probe = [(0, 0), (0, 1), (2, 1), (ref.P - 1, 5)]
+    out = np.asarray(run_rows(ksgn, 8, pack2(probe)))[0]
+    assert [int(x) for x in out] == [ref.fp2_sgn0(v) for v in probe]
+
+
+def test_map_to_curve_vs_oracle():
+    msgs = [b"pallas-map-%d" % i for i in range(B)]
+    us = [ref.hash_to_field_fp2(m, 2, ref.DST_G2)[0] for m in msgs]
+    # include u = 0 (exceptional inv0 path)
+    us[-1] = (0, 0)
+
+    def kmap(u):
+        x, y, _ = ph.map_to_curve_g2(_t2(u))
+        return jnp.concatenate([x[0], x[1], y[0], y[1]], axis=0)
+
+    out = np.asarray(run_rows(kmap, 4 * NL, pack2(us)))
+    for i in range(B):
+        got = (unpack2(out[: 2 * NL], i), unpack2(out[2 * NL :], i))
+        assert got == ref.SVDW_G2.map_to_curve(us[i]), i
+
+
+def _proj_rows(pts):
+    """Affine oracle points -> (6*NL, B) projective rows (Z = 1)."""
+    return jnp.asarray(np.concatenate([
+        np.asarray(pack2([p[0] for p in pts])),
+        np.asarray(pack2([p[1] for p in pts])),
+        np.asarray(pack2([(1, 0)] * len(pts))),
+    ], axis=0))
+
+
+def _aff_from_proj(out, i):
+    x = unpack2(out[0 * NL : 2 * NL], i)
+    y = unpack2(out[2 * NL : 4 * NL], i)
+    z = unpack2(out[4 * NL : 6 * NL], i)
+    zi = ref.fp2_inv(z)
+    return (ref.fp2_mul(x, zi), ref.fp2_mul(y, zi))
+
+
+def test_psi_and_ladder_vs_oracle():
+    pts = [ref.g2_mul(ref.G2_GEN, 999 + 7 * i) for i in range(B)]
+    rows = _proj_rows(pts)
+
+    def kpsi(s):
+        p = ph._stack_to_pt(s)
+        return ph._pt_to_stack(ph.g2_psi(p))
+
+    out = np.asarray(run_rows(kpsi, 6 * NL, rows))
+    for i in range(B):
+        assert _aff_from_proj(out, i) == ref.g2_psi(pts[i]), i
+
+    def kmulx(s):
+        return ph._pt_to_stack(ph._mul_neg_x(ph._stack_to_pt(s)))
+
+    out = np.asarray(run_rows(kmulx, 6 * NL, rows))
+    for i in range(B):
+        assert _aff_from_proj(out, i) == ref._g2_mul_x(pts[i]), i
+
+
+@pytest.mark.slow
+def test_clear_cofactor_vs_oracle():
+    """Interpreter-mode two-ladder clearing (slow: ~10 min on 1 core).
+    Its components (psi, x-ladder, point adds) are covered above; the
+    composed path runs on real TPU in bench.py / JaxScheme."""
+    # map outputs (NOT in the subgroup) — the real input distribution
+    us = [ref.hash_to_field_fp2(b"cc-%d" % i, 2, ref.DST_G2)[0]
+          for i in range(B)]
+    pts = [ref.SVDW_G2.map_to_curve(u) for u in us]
+    rows = _proj_rows(pts)
+
+    def kcc(s):
+        return ph._pt_to_stack(ph.clear_cofactor_g2(ph._stack_to_pt(s)))
+
+    out = np.asarray(run_rows(kcc, 6 * NL, rows))
+    for i in range(B):
+        got = _aff_from_proj(out, i)
+        assert got == ref.g2_clear_cofactor(pts[i]), i
+        assert ref.ec_mul(ref.FP2_OPS, got, ref.R) is None
+
+
+@pytest.mark.slow
+def test_full_hash_kernel_interpret():
+    """Full u -> G2 hash kernel under the interpreter (slow; the TPU path
+    is exercised by bench.py and JaxScheme)."""
+    from drand_tpu.ops import h2c as opg
+
+    msgs = [b"full-%d" % i for i in range(B)]
+    u0, u1 = opg.hash_to_field_device(msgs)
+    out = np.asarray(ph.hash_to_g2(u0, u1, block=B, interpret=True))
+    for i, m in enumerate(msgs):
+        from drand_tpu.ops import tower
+
+        got = (tower.fp2_decode(out[i][0]), tower.fp2_decode(out[i][1]))
+        assert got == ref.hash_to_g2(m)

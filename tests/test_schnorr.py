@@ -1,0 +1,37 @@
+"""Schnorr signatures over G1 (DKG message authentication — the
+reference's kyber vss signs Deals/Responses/Justifications)."""
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto import schnorr
+
+
+def test_sign_verify_roundtrip():
+    sk = 0xC0FFEE % ref.R
+    pk = ref.g1_mul(ref.G1_GEN, sk)
+    sig = schnorr.sign(sk, b"hello dkg")
+    assert len(sig) == schnorr.SIG_LEN
+    assert schnorr.verify(pk, b"hello dkg", sig)
+    # deterministic
+    assert schnorr.sign(sk, b"hello dkg") == sig
+
+
+def test_rejections():
+    sk = 0xBEEF % ref.R
+    pk = ref.g1_mul(ref.G1_GEN, sk)
+    sig = schnorr.sign(sk, b"msg")
+    # wrong message
+    assert not schnorr.verify(pk, b"other", sig)
+    # wrong key
+    pk2 = ref.g1_mul(ref.G1_GEN, sk + 1)
+    assert not schnorr.verify(pk2, b"msg", sig)
+    # tampered signature halves
+    bad_r = bytes([sig[0] ^ 1]) + sig[1:]
+    assert not schnorr.verify(pk, b"msg", bad_r)
+    bad_s = sig[:-1] + bytes([sig[-1] ^ 1])
+    assert not schnorr.verify(pk, b"msg", bad_s)
+    # malformed
+    assert not schnorr.verify(pk, b"msg", b"")
+    assert not schnorr.verify(pk, b"msg", b"\x00" * schnorr.SIG_LEN)
+    # s >= r rejected
+    big_s = sig[:48] + (ref.R).to_bytes(32, "big")
+    assert not schnorr.verify(pk, b"msg", big_s)

@@ -1,0 +1,77 @@
+"""Microbenchmark: device H2C + fused verify stage timings (real TPU).
+
+Usage: python tools/h2c_micro.py [batch]
+"""
+
+import sys
+import pathlib
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import jax
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.ops import curve, fp, h2c as opg
+from drand_tpu.ops import pallas_h2c as ph
+
+
+def timeit(name, fn, items, iters=4):
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name}: {dt*1000:.1f} ms/call ({items/dt:.0f} items/s)",
+          flush=True)
+    return dt
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    msgs = [b"micro-%d" % i for i in range(batch)]
+
+    timeit("host hash_to_field + encode",
+           lambda: opg.hash_to_field_device(msgs), batch)
+    u0, u1 = opg.hash_to_field_device(msgs)
+    timeit("pallas hash_to_g2", lambda: ph.hash_to_g2(u0, u1), batch)
+
+    # fused end-to-end verify kernel
+    sk = 0x5EED % ref.R
+    pk = ref.g1_mul(ref.G1_GEN, sk)
+    neg_g = ref.g1_neg(ref.G1_GEN)
+    import jax.numpy as jnp
+
+    h_aff = ph.hash_to_g2(u0, u1)
+    one = jnp.broadcast_to(
+        fp.to_mont(jnp.asarray(np.stack(
+            [fp.int_to_limbs(1), fp.int_to_limbs(0)]
+        ))), (batch, 1, 2, fp.NLIMB))
+    h_proj = jnp.concatenate([h_aff, one], axis=1)
+    skb = jnp.broadcast_to(jnp.asarray(curve.scalar_to_bits(sk)),
+                           (batch, 256))
+    sig = curve.g2_scalar_mul(h_proj, skb)
+    sx, sy = curve.g2_to_affine(sig)
+    q1 = jnp.stack([sx, sy], axis=1)
+    ends = curve.g1_affine_encode_batch([neg_g, pk])
+    p1 = jnp.broadcast_to(ends[0], (batch, 2, fp.NLIMB))
+    p2 = jnp.broadcast_to(ends[1], (batch, 2, fp.NLIMB))
+
+    ok = np.asarray(ph.pairing_product_check_hashed(p1, q1, p2, u0, u1))
+    assert ok.all(), "fused verify failed"
+    timeit("fused check_hashed (kernel only)",
+           lambda: ph.pairing_product_check_hashed(p1, q1, p2, u0, u1),
+           batch)
+
+    def e2e():
+        a, b = opg.hash_to_field_device(msgs)
+        return ph.pairing_product_check_hashed(p1, q1, p2, a, b)
+
+    timeit("end-to-end bytes -> verified", e2e, batch)
+
+
+if __name__ == "__main__":
+    main()
