@@ -25,14 +25,63 @@ Environment knobs:
   BENCH_ITERS   timed iterations         (default 4)
   BENCH_KERNEL  "pallas" (default: the mega-kernel) or "opgraph"
   BENCH_DEVICE_ONLY  "1": skip hashing, time the pairing check alone
+  BENCH_PROBE_TIMEOUT  seconds to wait for the ambient JAX backend
+                       before falling back to CPU (default 240)
+
+If the ambient accelerator backend is broken (the axon TPU tunnel can
+either raise at init or hang indefinitely — BENCH_r02 recorded rc=1 with
+no parseable output), the bench re-execs itself with JAX_PLATFORMS=cpu
+and a small batch so a real, honest number is always recorded.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+
+def _backend_alive(timeout: float) -> bool:
+    """Probe ambient JAX backend init in a SUBPROCESS: a broken TPU
+    tunnel can hang inside xla_bridge.backends() rather than raise, so
+    an in-process try/except is not enough."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            timeout=timeout, capture_output=True,
+        )
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def _maybe_fallback_to_cpu() -> None:
+    """Re-exec with a forced CPU backend (and a batch sized for a 1-core
+    host) when the ambient backend is dead.  Runs before any jax import
+    so the broken backend is never initialized in this process."""
+    if os.environ.get("BENCH_FALLBACK") == "1":
+        return
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return  # already on the fallback platform
+    # NOTE: a pinned JAX_PLATFORMS (this host exports JAX_PLATFORMS=axon)
+    # is NOT trusted — the pinned backend is exactly what breaks; the
+    # probe below inherits the pin and decides.
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    if _backend_alive(timeout):
+        return
+    env = dict(os.environ)
+    env["BENCH_FALLBACK"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    # the accelerator tunnel's sitecustomize re-registers (and re-pins
+    # JAX_PLATFORMS to) its broken backend at interpreter start when
+    # this var is present; dropping it is what actually disables it
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.setdefault("BENCH_BATCH", "32")
+    env.setdefault("BENCH_ITERS", "2")
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 def select_check_kernel():
@@ -116,8 +165,7 @@ def main() -> None:
     ok = np.asarray(verify_e2e(msgs) if not device_only
                     else verify_device_only(q2_fixed))
     if not ok.all():
-        print(json.dumps({"error": "verification failed in warmup"}))
-        sys.exit(1)
+        raise RuntimeError("verification failed in warmup")
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -142,10 +190,23 @@ def main() -> None:
             "iters": iters,
             "seconds": round(dt, 3),
             "device": str(jax.devices()[0]),
+            "cpu_fallback": os.environ.get("BENCH_FALLBACK") == "1",
             "est_1M_rounds_seconds": round(1_000_000 / rounds_per_sec, 1),
         },
     }))
 
 
 if __name__ == "__main__":
-    main()
+    _maybe_fallback_to_cpu()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — always emit the JSON line
+        print(json.dumps({
+            "metric": "beacon-chain batch-verify throughput, incl. "
+                      "hash-to-curve (BLS12-381 pairings/sec/chip)",
+            "value": 0.0,
+            "unit": "pairings/sec/chip",
+            "vs_baseline": 0.0,
+            "detail": {"error": "%s: %s" % (type(e).__name__, str(e)[:400])},
+        }))
+        sys.exit(1)

@@ -211,10 +211,19 @@ def bench_256chains(batch_per_chain: int = 8) -> None:
 
 
 def main() -> None:
+    fallback = os.environ.get("BENCH_FALLBACK") == "1"
     batch = int(os.environ.get("BENCH_BATCH", "512"))
-    chain_n = int(os.environ.get("BENCH_CHAIN_N", "10240"))
+    chain_n = int(os.environ.get("BENCH_CHAIN_N",
+                                 "256" if fallback else "10240"))
     only = os.environ.get("BENCH_SUITE")
     wanted = set(only.split(",")) if only else None
+    if fallback and wanted is None:
+        # a 1-core CPU fallback can't usefully run the committee-scale /
+        # sharded configs; record the reduced coverage explicitly
+        wanted = {"demo-3of5", "chain-10k", "67of100"}
+        print(json.dumps({"config": "_note", "cpu_fallback": True,
+                          "skipped": ["667of1000", "256chains"]}),
+              flush=True)
 
     def want(name: str) -> bool:
         return wanted is None or name in wanted
@@ -232,4 +241,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    from bench import _maybe_fallback_to_cpu
+
+    _maybe_fallback_to_cpu()
     main()

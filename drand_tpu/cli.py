@@ -145,6 +145,7 @@ def cmd_start(args) -> int:
             listen_addr=args.listen or pair.public.address,
             control_port=args.control,
             rest_port=args.rest_port,
+            mux_port=args.mux_port,
             scheme=tbls.default_scheme(args.backend),
             tls_cert=tls_cert,
             tls_key=tls_key,
@@ -199,6 +200,11 @@ def cmd_ping(args) -> int:
 
 def cmd_share(args) -> int:
     group_toml = Path(args.group).read_text()
+    entropy = None
+    if getattr(args, "source", None):
+        from drand_tpu.entropy import get_random
+
+        entropy = get_random(32, args.source)
 
     async def run() -> str:
         c = _control(args)
@@ -210,15 +216,18 @@ def cmd_share(args) -> int:
                     old_group_toml=old_toml,
                     is_leader=args.leader,
                     timeout=args.timeout,
+                    entropy=entropy,
                 )
             if args.reshare:
                 return await c.init_reshare(
                     new_group_toml=group_toml,
                     is_leader=args.leader,
                     timeout=args.timeout,
+                    entropy=entropy,
                 )
             return await c.init_dkg(
-                group_toml, is_leader=args.leader, timeout=args.timeout
+                group_toml, is_leader=args.leader, timeout=args.timeout,
+                entropy=entropy,
             )
         finally:
             await c.close()
@@ -347,6 +356,9 @@ def build_parser() -> argparse.ArgumentParser:
     g = sub.add_parser("start")
     g.add_argument("--listen")
     g.add_argument("--rest-port", type=int)
+    g.add_argument("--mux-port", type=int,
+                   help="serve gRPC AND REST on this one port (the "
+                        "reference's cmux listener); TLS applies to it")
     g.add_argument("--tls-cert",
                    help="PEM certificate; enables TLS on gRPC + REST")
     g.add_argument("--tls-key", help="PEM private key")
@@ -380,6 +392,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--reshare", action="store_true",
                    help="reshare using the daemon's stored group")
     g.add_argument("--from-group", help="old group TOML (reshare)")
+    g.add_argument("--source",
+                   help="executable whose stdout supplies extra DKG "
+                        "entropy, mixed with the OS CSPRNG (reference: "
+                        "entropy.ScriptReader, main.go --source flag)")
     g.set_defaults(fn=cmd_share)
 
     g = sub.add_parser("get")

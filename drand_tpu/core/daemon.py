@@ -75,6 +75,10 @@ class Config:
     control_port: int = DEFAULT_CONTROL_PORT
     rest_port: Optional[int] = None      # REST gateway (None = disabled)
     rest_host: str = "0.0.0.0"           # REST bind host
+    #: ONE public port serving both gRPC and REST (the reference's cmux
+    #: listener, net/listener_grpc.go:23-97); backends move to loopback
+    #: and TLS — when configured — terminates at the mux
+    mux_port: Optional[int] = None
     tls_cert: Optional[bytes] = None     # PEM (with tls_key enables TLS)
     tls_key: Optional[bytes] = None
     cert_manager: CertManager = field(default_factory=CertManager)
@@ -145,9 +149,44 @@ class Drand:
             if not (self.cfg.tls_cert and self.cfg.tls_key):
                 raise ValueError("TLS requires tls_cert and tls_key")
             tls = (self.cfg.tls_cert, self.cfg.tls_key)
-        server = build_public_server(self, self.cfg.listen_addr, tls=tls)
-        await server.start()
-        self._servers.append(server)
+        if self.cfg.mux_port is not None:
+            # single-port mode: gRPC + REST on loopback, spliced behind
+            # one public port; TLS terminates at the mux (reference
+            # net/listener_grpc.go:108 NewTLSGrpcListener)
+            from drand_tpu.net.mux import start_mux
+            from drand_tpu.net.rest import build_rest_app, start_rest
+
+            # the mux replaces the listen_addr listener, so the port
+            # peers dial (the one in the group TOML) must be the mux's —
+            # a silent mismatch would refuse every inbound DKG/beacon RPC
+            adv = self.cfg.listen_addr.rsplit(":", 1)
+            if len(adv) == 2 and adv[1] not in ("0", str(self.cfg.mux_port)):
+                raise ValueError(
+                    f"mux_port {self.cfg.mux_port} differs from the "
+                    f"advertised port in listen_addr {self.cfg.listen_addr}"
+                )
+
+            server, gport = build_public_server(
+                self, "127.0.0.1:0", tls=None
+            )
+            await server.start()
+            self._servers.append(server)
+            runner, rport = await start_rest(
+                build_rest_app(self), 0, host="127.0.0.1"
+            )
+            self._servers.append(runner)
+            host = self.cfg.listen_addr.rsplit(":", 1)[0] or "0.0.0.0"
+            ssl_ctx = (self._server_ssl_context(*tls)
+                       if tls is not None else None)
+            mux = await start_mux(self.cfg.mux_port, gport, rport,
+                                  host=host, ssl_context=ssl_ctx)
+            self._servers.append(mux)
+        else:
+            server, _ = build_public_server(
+                self, self.cfg.listen_addr, tls=tls
+            )
+            await server.start()
+            self._servers.append(server)
         control = build_control_server(self, self.cfg.control_port)
         await control.start()
         self._servers.append(control)
@@ -156,14 +195,14 @@ class Drand:
 
             ssl_ctx = None
             if tls is not None:
-                ssl_ctx = self._rest_ssl_context(*tls)
-            runner = await start_rest(
+                ssl_ctx = self._server_ssl_context(*tls)
+            runner, _ = await start_rest(
                 build_rest_app(self), self.cfg.rest_port,
                 host=self.cfg.rest_host, ssl_context=ssl_ctx,
             )
             self._servers.append(runner)
 
-    def _rest_ssl_context(self, cert_pem: bytes, key_pem: bytes):
+    def _server_ssl_context(self, cert_pem: bytes, key_pem: bytes):
         """ssl.SSLContext from PEM bytes (the ssl module only loads from
         files, so the material lands in the daemon folder, 0600)."""
         import ssl
@@ -271,7 +310,8 @@ class Drand:
 
     async def init_reshare(self, new_group_toml: str, is_leader: bool,
                            old_group_toml: Optional[str] = None,
-                           timeout: Optional[float] = None) -> str:
+                           timeout: Optional[float] = None,
+                           entropy: Optional[bytes] = None) -> str:
         """Control-plane resharing (reference InitReshare
         core/drand_control.go:91-205): same collective key and chain, new
         membership/threshold, beacon handover at the transition round."""
@@ -310,6 +350,7 @@ class Drand:
             old_share=old_share,
             timeout=timeout or self.cfg.dkg_timeout,
             clock=self.clock,
+            entropy=entropy,
         )
         self.dkg = DKGHandler(cfg, self._client)
         if is_leader:

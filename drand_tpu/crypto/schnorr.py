@@ -27,9 +27,17 @@ SIG_LEN = 48 + 32
 _DST = b"drand-tpu-schnorr-v1"
 
 
-def _challenge(r_bytes: bytes, pk_bytes: bytes, msg: bytes) -> int:
-    h = hashlib.sha256(_DST + r_bytes + pk_bytes + msg).digest()
+def _wide_reduce(h: bytes) -> int:
+    """Reduce a 64-byte digest mod R.  A 256-bit digest into the
+    ~255-bit order leaves some residues ~1.5x more likely (2^256/R ≈
+    2.2); 512 bits makes the bias < 2^-255 (RFC 9380 hash_to_field
+    practice, L >= 48 bytes)."""
     return int.from_bytes(h, "big") % ref.R
+
+
+def _challenge(r_bytes: bytes, pk_bytes: bytes, msg: bytes) -> int:
+    return _wide_reduce(
+        hashlib.sha512(_DST + r_bytes + pk_bytes + msg).digest())
 
 
 _PK_CACHE: dict = {}
@@ -41,11 +49,8 @@ def sign(sk: int, msg: bytes) -> bytes:
     if pk_bytes is None:
         pk_bytes = ref.g1_to_bytes(ref.g1_mul(ref.G1_GEN, sk))
         _PK_CACHE[sk] = pk_bytes
-    k = int.from_bytes(
-        hashlib.sha256(
-            _DST + sk.to_bytes(32, "big") + msg
-        ).digest(), "big",
-    ) % ref.R
+    k = _wide_reduce(hashlib.sha512(
+        _DST + sk.to_bytes(32, "big") + msg).digest())
     if k == 0:
         k = 1
     r_bytes = ref.g1_to_bytes(ref.g1_mul(ref.G1_GEN, k))

@@ -418,15 +418,11 @@ class DistKeyGenerator:
             just.signature,
         ):
             raise DKGError("justification signature invalid")
-        # a justification must ANSWER a recorded complaint (kyber's
-        # aggregator rejects unsolicited ones): without this gate a rogue
-        # dealer could self-certify by publishing justifications for
-        # every verifier, bypassing genuine approvals entirely.  If the
-        # complaint simply hasn't arrived yet (async ordering), buffer
-        # the justification and replay it from process_response.
-        if v not in self._complaints.get(d, ()):
-            self._early_justs[(d, v)] = just
-            return
+        # the proof-of-cheating check runs UNCONDITIONALLY: a dealer that
+        # signs an invalid justification convicts itself on every node,
+        # whether or not that node happens to have recorded the matching
+        # complaint (the complainer itself may hold an approval instead —
+        # first response wins — and must still convict)
         try:
             commits = just.commits()
             if len(commits) != self.threshold:
@@ -445,11 +441,23 @@ class DistKeyGenerator:
             value = just.share_value % ref.R
             if ref.g1_mul(ref.G1_GEN, value) != _eval_commits(commits, v):
                 raise DKGError("revealed sub-share fails commitments")
-        except DKGError:
+        except (DKGError, ValueError):
             # provably cheating: an honest dealer can always produce a
-            # valid justification for its own dealing
+            # valid justification for its own dealing.  ValueError covers
+            # malformed commit encodings (wrong length / off-curve), the
+            # same provable-garbage class process_deal treats as invalid.
             self._bad_dealers.add(d)
             self._approvals.pop(d, None)
+            return
+        # a VALID justification only NEUTRALIZES a recorded complaint
+        # (kyber's aggregator rejects unsolicited ones): without this
+        # gate a rogue dealer could self-certify by publishing
+        # justifications for every verifier, bypassing genuine approvals
+        # entirely.  If the complaint simply hasn't arrived yet (async
+        # ordering), buffer the justification and replay it from
+        # process_response.
+        if v not in self._complaints.get(d, ()):
+            self._early_justs[(d, v)] = just
             return
         # valid: neutralize the complaint
         self._complaints.get(d, set()).discard(v)

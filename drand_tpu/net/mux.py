@@ -1,0 +1,124 @@
+"""Single-port gRPC + REST demultiplexer (the reference's cmux).
+
+The reference serves gRPC and JSON/REST on ONE public port: cmux sniffs
+the connection for insecure listeners and an http.Handler dispatches on
+the h2 content-type for TLS listeners
+(/root/reference/net/listener_grpc.go:23-97,230-242).
+
+Here the same capability is an asyncio front listener: every accepted
+connection is classified by its first bytes — an HTTP/2 client
+connection preface (``PRI * HTTP/2.0``) means gRPC, anything else is
+HTTP/1.x for the REST gateway — and then spliced byte-for-byte onto the
+matching loopback backend.  With TLS, the mux terminates the handshake
+itself (ALPN h2 + http/1.1, which gRPC clients require) and forwards
+plaintext; the backends bind 127.0.0.1 only.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Set
+
+#: HTTP/2 client connection preface, RFC 7540 §3.5.  gRPC always opens
+#: with it; no HTTP/1.x method shares the first four bytes.
+_H2_PREFACE_HEAD = b"PRI "
+
+
+class MuxServer:
+    """Front listener splicing connections to gRPC / REST backends."""
+
+    def __init__(self, server: asyncio.base_events.Server,
+                 tasks: Set[asyncio.Task]):
+        self._server = server
+        self._tasks = tasks
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    async def cleanup(self) -> None:
+        """Close the listener and all spliced connections (duck-typed to
+        slot into Drand._servers next to aiohttp runners)."""
+        self._server.close()
+        await self._server.wait_closed()
+        for t in list(self._tasks):
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+
+
+async def _splice(reader: asyncio.StreamReader,
+                  writer: asyncio.StreamWriter) -> None:
+    try:
+        while True:
+            data = await reader.read(1 << 16)
+            if not data:
+                break
+            writer.write(data)
+            await writer.drain()
+    except (OSError, asyncio.IncompleteReadError):
+        # OSError covers ssl.SSLError: an unclean TLS abort (no
+        # close_notify) must not surface as an unretrieved task exception
+        pass
+    finally:
+        # propagate FIN so half-closed gRPC/HTTP streams finish cleanly
+        try:
+            if writer.can_write_eof():
+                writer.write_eof()
+        except (OSError, RuntimeError):
+            pass
+
+
+async def _close(writer: asyncio.StreamWriter) -> None:
+    try:
+        writer.close()
+        await writer.wait_closed()
+    except (ConnectionError, OSError):
+        pass
+
+
+async def start_mux(port: int, grpc_port: int, rest_port: int,
+                    host: str = "0.0.0.0",
+                    ssl_context=None) -> MuxServer:
+    """Serve `port`, splicing gRPC to 127.0.0.1:grpc_port and everything
+    else to 127.0.0.1:rest_port.  `ssl_context` (server-side, ALPN is
+    configured here) makes the single port TLS like the reference's
+    NewTLSGrpcListener."""
+    if ssl_context is not None:
+        ssl_context.set_alpn_protocols(["h2", "http/1.1"])
+    tasks: Set[asyncio.Task] = set()
+
+    async def handle(reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            # readexactly: a preface split across TCP segments/TLS records
+            # must not be classified on a short read
+            head = await asyncio.wait_for(
+                reader.readexactly(4), timeout=10.0
+            )
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                OSError):
+            await _close(writer)
+            return
+        backend = grpc_port if head == _H2_PREFACE_HEAD else rest_port
+        try:
+            br, bw = await asyncio.open_connection("127.0.0.1", backend)
+        except OSError:
+            await _close(writer)
+            return
+        bw.write(head)
+        try:
+            await asyncio.gather(_splice(reader, bw), _splice(br, writer))
+        finally:
+            await _close(bw)
+            await _close(writer)
+
+    def track(reader, writer):
+        t = asyncio.ensure_future(handle(reader, writer))
+        tasks.add(t)
+        t.add_done_callback(tasks.discard)
+
+    server = await asyncio.start_server(
+        track, host, port, ssl=ssl_context
+    )
+    return MuxServer(server, tasks)

@@ -20,6 +20,47 @@ from __future__ import annotations
 
 from aiohttp import web
 
+#: Live beacon dashboard (TPU-native stand-in for the reference's Hugo
+#: site under /root/reference/web/ — there it is a static marketing/docs
+#: site; here the useful part: watch the chain advance, inspect the
+#: group, fetch any round, all against the node's own REST API).
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>drand-tpu</title>
+<style>
+ body{font-family:ui-monospace,Menlo,monospace;background:#101418;
+      color:#d7dde3;max-width:60rem;margin:2rem auto;padding:0 1rem}
+ h1{font-size:1.2rem} .k{color:#7da7d9} .v{word-break:break-all}
+ table{border-collapse:collapse;width:100%} td{padding:.25rem .5rem;
+ border-bottom:1px solid #2a3138;vertical-align:top}
+ input{background:#1a2026;color:inherit;border:1px solid #2a3138;
+ padding:.25rem .5rem} .err{color:#e08080}
+</style></head><body>
+<h1>drand-tpu beacon</h1>
+<table id="t"><tr><td class="k">status</td><td class="v" id="s">connecting…
+</td></tr></table>
+<p>round: <input id="r" size="10" placeholder="latest">
+<button onclick="load()">fetch</button></p>
+<script>
+async function j(p){const r=await fetch(p);if(!r.ok)throw new Error(
+  r.status+" "+await r.text());return r.json()}
+function row(k,v){return '<tr><td class="k">'+k+'</td><td class="v">'+v+
+  '</td></tr>'}
+async function load(){
+  const t=document.getElementById('t'),n=document.getElementById('r').value;
+  try{
+    const b=await j(n?'/api/public/'+n:'/api/public');
+    let h=row('round',b.round)+row('randomness',b.randomness)+
+          row('signature',b.signature)+row('previous round',
+          b.previous_round)+row('previous sig',b.previous);
+    try{const d=await j('/api/info/distkey');
+        h+=row('collective key',d.coefficients[0])}catch(e){}
+    t.innerHTML=h;
+  }catch(e){t.innerHTML=row('status','<span class="err">'+e+'</span>')}
+}
+load();setInterval(()=>{if(!document.getElementById('r').value)load()},2000);
+</script></body></html>
+"""
+
 
 def build_rest_app(daemon) -> web.Application:
     routes = web.RouteTableDef()
@@ -92,6 +133,11 @@ def build_rest_app(daemon) -> web.Application:
             raise web.HTTPNotFound(text=str(exc))
         return web.json_response({"coefficients": coeffs})
 
+    @routes.get("/web")
+    async def dashboard(request):
+        return web.Response(text=_DASHBOARD_HTML,
+                            content_type="text/html", charset="utf-8")
+
     app = web.Application()
     app.add_routes(routes)
     return app
@@ -99,13 +145,15 @@ def build_rest_app(daemon) -> web.Application:
 
 async def start_rest(app: web.Application, port: int,
                      host: str = "0.0.0.0",
-                     ssl_context=None) -> web.AppRunner:
+                     ssl_context=None):
     """Serve the gateway; pass an `ssl.SSLContext` to serve HTTPS (the
     reference serves REST through the same TLS listener as gRPC,
-    net/listener_grpc.go:108-168 — here it is the same certificate on
-    the REST port)."""
+    net/listener_grpc.go:108-168 — with `core.Config.mux_port` that is
+    literally the same port; standalone it is the same certificate on
+    the REST port).  Returns ``(runner, bound_port)``."""
     runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, host, port, ssl_context=ssl_context)
     await site.start()
-    return runner
+    bound = runner.addresses[0][1]
+    return runner, bound

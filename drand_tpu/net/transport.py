@@ -57,8 +57,11 @@ def _record_to_beacon(r: pb.BeaconRecord) -> Beacon:
 
 
 def build_public_server(daemon, address: str,
-                        tls: Optional[tuple] = None) -> grpc.aio.Server:
-    """The node-to-node + public gateway (Public and Protocol services)."""
+                        tls: Optional[tuple] = None):
+    """The node-to-node + public gateway (Public and Protocol services).
+
+    Returns ``(server, bound_port)`` — the port matters when binding
+    ``:0`` (loopback backends behind the single-port mux)."""
 
     async def public_rand(request, context):
         try:
@@ -195,10 +198,10 @@ def build_public_server(daemon, address: str,
     if tls is not None:
         cert_pem, key_pem = tls
         creds = grpc.ssl_server_credentials([(key_pem, cert_pem)])
-        server.add_secure_port(address, creds)
+        port = server.add_secure_port(address, creds)
     else:
-        server.add_insecure_port(address)
-    return server
+        port = server.add_insecure_port(address)
+    return server, port
 
 
 async def _dkg_inbound(daemon, request, context, reshare: bool):
@@ -241,6 +244,7 @@ def build_control_server(daemon, port: int) -> grpc.aio.Server:
                 new_group_toml=request.new_group_toml,
                 is_leader=request.is_leader,
                 timeout=request.timeout_seconds or None,
+                entropy=request.entropy or None,
             )
         except Exception as exc:
             await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
@@ -539,6 +543,7 @@ class ControlClient:
     async def init_reshare(self, new_group_toml: str, is_leader: bool,
                            old_group_toml: Optional[str] = None,
                            timeout: Optional[float] = None,
+                           entropy: Optional[bytes] = None,
                            rpc_timeout: float = 600.0) -> str:
         resp = await self._call(
             "InitReshare", pb.InitReshareRequest.SerializeToString,
@@ -548,6 +553,7 @@ class ControlClient:
                 old_group_toml=old_group_toml or "",
                 new_group_toml=new_group_toml,
                 is_leader=is_leader, timeout_seconds=timeout or 0.0,
+                entropy=entropy or b"",
             ),
             timeout=rpc_timeout,
         )

@@ -2,15 +2,28 @@
 
 Replaces the inner loop of kyber's `share.RecoverCommit` (used by
 `tbls.Recover` at /root/reference/beacon/beacon.go:488): the reference
-computes sum_i lambda_i * S_i sequentially on the CPU; here the per-point
-scalar multiplications run as one batched 256-step double-and-select scan
-(vmapped over points), followed by a log-depth pairwise reduction tree —
-both fully on-device with static shapes.
+computes sum_i lambda_i * S_i sequentially on the CPU; here the whole sum
+runs on-device with static shapes.
 
-For drand committee sizes (t up to ~667) the vmap+tree shape is the right
-TPU mapping: all points advance through the same bit schedule in lockstep,
-so the work is one (B, ...) vector op per step with zero gathers; a
-Pippenger bucket method would need data-dependent scatters, which TPUs hate.
+Algorithm: fixed 4-bit windows with SHARED doublings (Horner over window
+columns).  Write each scalar as 64 base-16 digits, MSB first:
+
+    sum_i k_i P_i  =  sum_j 16^(63-j) * W_j,    W_j = sum_i T_i[d_ij]
+
+where T_i[v] = v * P_i is a 16-entry per-point table.  The evaluation is
+then Horner: acc <- 16*acc + W_j.  Per batch of B points this costs
+
+    table:   14 batched point ops
+    W_j:     64 * (B-1) adds, executed as log2(B) FAT batched point_adds
+             over all 64 window columns at once (TPU-friendly: a handful
+             of wide kernels instead of a 256-step scan)
+    Horner:  256 doubles + 64 adds on a single point
+
+— about 8x less field work than the previous per-point 256-step
+double-and-select ladder (256*B doubles + 256*B selected adds), with the
+digit->table lookup done as a one-hot contraction (no data-dependent
+gathers, which TPUs hate; a Pippenger bucket method would need scatters
+and is wrong for this hardware).
 """
 
 from __future__ import annotations
@@ -19,15 +32,76 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from drand_tpu.ops.curve import (
     F1,
     F2,
     FieldOps,
+    SCALAR_BITS,
     point_add,
+    point_double,
     point_identity,
-    scalar_mul,
+    point_select,
 )
+
+WINDOW = 4
+NDIGITS = SCALAR_BITS // WINDOW          # 64 base-16 digits
+TABLE = 1 << WINDOW                      # 16 table entries
+
+
+def _digits(bits, window=WINDOW):
+    """MSB-first bit array (B, 256) -> (B, NDIGITS) base-2^w digits."""
+    b = bits.shape[0]
+    w = bits.reshape(b, SCALAR_BITS // window, window)
+    weights = jnp.asarray(
+        [1 << (window - 1 - i) for i in range(window)], dtype=jnp.int32
+    )
+    return (w.astype(jnp.int32) * weights).sum(-1)
+
+
+def _table(points, F: FieldOps):
+    """Per-point multiples T[v] = v*P, v in [0, 16): (16, B, 3, ...)."""
+    ident = jnp.broadcast_to(
+        point_identity(F), points.shape
+    ).astype(points.dtype)
+    entries = [ident, points]
+    for v in range(2, TABLE):
+        if v % 2 == 0:
+            entries.append(point_double(entries[v // 2], F))
+        else:
+            entries.append(point_add(entries[v - 1], points, F))
+    return jnp.stack(entries, 0)
+
+
+def _window_sums(points, bits, F: FieldOps):
+    """W_j = sum_i T_i[d_ij] for every window column: (NDIGITS, 3, ...).
+
+    The digit lookup is a one-hot contraction over the 16-entry axis and
+    the per-window partial sums reduce over the point axis as a padded
+    pairwise tree — each tree level is ONE point_add over all 64 window
+    columns at the current width.
+    """
+    tab = _table(points, F)                       # (16, B, 3, ...)
+    digits = _digits(bits)                        # (B, 64)
+    onehot = (
+        digits[..., None] == jnp.arange(TABLE, dtype=jnp.int32)
+    ).astype(tab.dtype)                           # (B, 64, 16)
+    chosen = jnp.einsum("ijv,vi...->ji...", onehot, tab)  # (64, B, 3, ...)
+
+    b = chosen.shape[1]
+    n = 1
+    while n < b:
+        n *= 2
+    if n != b:
+        pad = jnp.broadcast_to(
+            point_identity(F), (chosen.shape[0], n - b, *chosen.shape[2:])
+        ).astype(chosen.dtype)
+        chosen = jnp.concatenate([chosen, pad], axis=1)
+    while chosen.shape[1] > 1:
+        half = chosen.shape[1] // 2
+        chosen = point_add(chosen[:, :half], chosen[:, half:], F)
+    return chosen[:, 0]                           # (64, 3, ...)
 
 
 def _msm(points, bits, F: FieldOps):
@@ -36,21 +110,20 @@ def _msm(points, bits, F: FieldOps):
     points: (B, 3, *field_shape), bits: (B, 256) MSB-first.
     Returns a single projective point (3, *field_shape).
     """
-    b = points.shape[0]
-    prods = scalar_mul(points, bits, F)  # (B, 3, ...) batched scan
-    # pad to a power of two with the identity, then halve repeatedly
-    n = 1
-    while n < b:
-        n *= 2
-    if n != b:
-        pad = jnp.broadcast_to(
-            point_identity(F), (n - b, *prods.shape[1:])
-        )
-        prods = jnp.concatenate([prods, pad], axis=0)
-    while prods.shape[0] > 1:
-        half = prods.shape[0] // 2
-        prods = point_add(prods[:half], prods[half:], F)
-    return prods[0]
+    wsum = _window_sums(points, bits, F)
+    # derive the carry from live data so manual/varying axes survive
+    # under shard_map (a plain constant carry breaks the scan type match)
+    acc0 = point_select(
+        jnp.zeros((), dtype=bool), wsum[0], point_identity(F), F
+    )
+
+    def step(acc, wj):
+        for _ in range(WINDOW):
+            acc = point_double(acc, F)
+        return point_add(acc, wj, F), None
+
+    out, _ = lax.scan(step, acc0, wsum)
+    return out
 
 
 g1_msm = jax.jit(partial(_msm, F=F1))

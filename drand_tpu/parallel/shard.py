@@ -22,8 +22,8 @@ from drand_tpu.ops.curve import (
     FieldOps,
     point_add,
     point_identity,
-    scalar_mul,
 )
+from drand_tpu.ops.msm import _msm as msm_local
 
 CHAIN_AXIS = "chains"
 
@@ -71,10 +71,8 @@ def _sharded_msm(points, bits, *, mesh: Mesh, F: FieldOps):
     axis = mesh.axis_names[0]
 
     def local(points, bits):
-        prods = scalar_mul(points, bits, F)
-        acc = prods[0]
-        for i in range(1, prods.shape[0]):
-            acc = point_add(acc, prods[i], F)
+        # windowed MSM (shared doublings) on the local shard
+        acc = msm_local(points, bits, F)
         gathered = jax.lax.all_gather(acc, axis)  # (n_dev, 3, ...)
         out = gathered[0]
         for i in range(1, gathered.shape[0]):

@@ -1,0 +1,136 @@
+"""Single-port gRPC+REST mux tests (reference cmux listener,
+net/listener_grpc.go:23-97 insecure, :108-168 TLS)."""
+
+import asyncio
+import ssl
+
+import aiohttp
+import pytest
+
+from drand_tpu.key import Identity, Pair
+from drand_tpu.net.mux import start_mux
+from drand_tpu.net.rest import build_rest_app, start_rest
+from drand_tpu.net.tls import CertManager, generate_self_signed
+from drand_tpu.net.transport import GrpcClient, build_public_server
+
+from test_core import free_ports
+
+
+class _FakeDaemon:
+    def home_status(self) -> str:
+        return "mux-smoke"
+
+    def fetch_public_rand(self, round):
+        raise KeyError("no chain")
+
+    def group_toml(self):
+        return None
+
+
+async def _backends():
+    fake = _FakeDaemon()
+    server, gport = build_public_server(fake, "127.0.0.1:0")
+    await server.start()
+    runner, rport = await start_rest(
+        build_rest_app(fake), 0, host="127.0.0.1"
+    )
+    return server, gport, runner, rport
+
+
+@pytest.mark.asyncio
+async def test_mux_insecure_grpc_and_rest_share_one_port():
+    (port,) = free_ports(1)
+    server, gport, runner, rport = await _backends()
+    mux = await start_mux(port, gport, rport, host="127.0.0.1")
+    try:
+        # gRPC through the mux port
+        client = GrpcClient(CertManager())
+        peer = Identity(address=f"127.0.0.1:{port}", key=None, tls=False)
+        assert await asyncio.wait_for(client.home(peer), 15) == "mux-smoke"
+        await client.close()
+
+        # REST through the SAME port
+        async with aiohttp.ClientSession() as http:
+            async with http.get(f"http://127.0.0.1:{port}/") as resp:
+                assert resp.status == 200
+                assert (await resp.json())["status"] == "mux-smoke"
+            async with http.get(f"http://127.0.0.1:{port}/web") as resp:
+                assert resp.status == 200
+                assert "drand-tpu" in await resp.text()
+    finally:
+        await mux.cleanup()
+        await runner.cleanup()
+        await server.stop(0.1)
+
+
+@pytest.mark.asyncio
+async def test_mux_tls_single_port(tmp_path):
+    (port,) = free_ports(1)
+    cert_pem, key_pem = generate_self_signed("127.0.0.1")
+    cpath, kpath = tmp_path / "c.pem", tmp_path / "k.pem"
+    cpath.write_bytes(cert_pem)
+    kpath.write_bytes(key_pem)
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server_ctx.load_cert_chain(cpath, kpath)
+
+    server, gport, runner, rport = await _backends()
+    mux = await start_mux(
+        port, gport, rport, host="127.0.0.1", ssl_context=server_ctx
+    )
+    try:
+        # TLS gRPC through the mux (client must trust the cert)
+        certs = CertManager()
+        certs.add(cert_pem)
+        client = GrpcClient(certs)
+        peer = Identity(address=f"127.0.0.1:{port}", key=None, tls=True)
+        assert await asyncio.wait_for(client.home(peer), 15) == "mux-smoke"
+        await client.close()
+
+        # HTTPS REST through the SAME port
+        client_ctx = ssl.create_default_context()
+        client_ctx.load_verify_locations(cadata=cert_pem.decode())
+        async with aiohttp.ClientSession() as http:
+            async with http.get(
+                f"https://127.0.0.1:{port}/", ssl=client_ctx
+            ) as resp:
+                assert resp.status == 200
+                assert (await resp.json())["status"] == "mux-smoke"
+
+        # an untrusting client must fail the handshake
+        stranger = GrpcClient(CertManager())
+        with pytest.raises(Exception):
+            await asyncio.wait_for(stranger.home(peer), 10)
+        await stranger.close()
+    finally:
+        await mux.cleanup()
+        await runner.cleanup()
+        await server.stop(0.1)
+
+
+@pytest.mark.asyncio
+async def test_daemon_mux_port():
+    """Drand with Config.mux_port serves both planes on one port."""
+    from drand_tpu.core import Config, Drand
+
+    mux_port, ctrl = free_ports(2)
+    pair = Pair.generate(f"127.0.0.1:{mux_port}")
+    cfg = Config(
+        listen_addr=f"127.0.0.1:{mux_port}",
+        control_port=ctrl,
+        in_memory=True,
+        mux_port=mux_port,
+    )
+    d = await Drand.new(cfg, pair)
+    try:
+        client = GrpcClient(CertManager())
+        peer = Identity(
+            address=f"127.0.0.1:{mux_port}", key=None, tls=False
+        )
+        status = await asyncio.wait_for(client.home(peer), 15)
+        assert status
+        await client.close()
+        async with aiohttp.ClientSession() as http:
+            async with http.get(f"http://127.0.0.1:{mux_port}/") as resp:
+                assert resp.status == 200
+    finally:
+        await d.stop()

@@ -29,7 +29,9 @@ async def test_tls_server_roundtrip_and_untrusted_rejected():
     addr = f"127.0.0.1:{port}"
     cert_pem, key_pem = generate_self_signed("127.0.0.1")
 
-    server = build_public_server(_FakeDaemon(), addr, tls=(cert_pem, key_pem))
+    server, _ = build_public_server(
+        _FakeDaemon(), addr, tls=(cert_pem, key_pem)
+    )
     await server.start()
     try:
         peer = Identity(address=addr, key=None, tls=True)

@@ -99,9 +99,9 @@ async def amain(port: int, rest_port: int, rounds: int) -> None:
     from drand_tpu.net.transport import build_public_server
 
     daemon = FakeDaemon(rounds)
-    server = build_public_server(daemon, f"127.0.0.1:{port}")
+    server, _ = build_public_server(daemon, f"127.0.0.1:{port}")
     await server.start()
-    runner = await start_rest(
+    runner, _ = await start_rest(
         build_rest_app(daemon), rest_port, host="127.0.0.1"
     )
     print(f"fake drand-tpu node: gRPC 127.0.0.1:{port}, "
