@@ -85,7 +85,13 @@ async def start_mux(port: int, grpc_port: int, rest_port: int,
     configured here) makes the single port TLS like the reference's
     NewTLSGrpcListener."""
     if ssl_context is not None:
-        ssl_context.set_alpn_protocols(["h2", "http/1.1"])
+        # server-preference order matters: OpenSSL selects the FIRST
+        # server protocol the client also offers.  http/1.1 first sends
+        # browsers/curl (which offer both h2 and http/1.1) to the REST
+        # plane, while gRPC clients offer ONLY h2 and still negotiate it
+        # — without this ordering every h2-capable HTTP client would
+        # sniff as gRPC and never reach /api or /web.
+        ssl_context.set_alpn_protocols(["http/1.1", "h2"])
     tasks: Set[asyncio.Task] = set()
 
     async def handle(reader: asyncio.StreamReader,
@@ -107,8 +113,21 @@ async def start_mux(port: int, grpc_port: int, rest_port: int,
             await _close(writer)
             return
         bw.write(head)
+        up = asyncio.ensure_future(_splice(reader, bw))
+        down = asyncio.ensure_future(_splice(br, writer))
         try:
-            await asyncio.gather(_splice(reader, bw), _splice(br, writer))
+            # once the backend stops sending, the response is complete.
+            # TLS transports cannot half-close (can_write_eof() is
+            # False), so a client reading to EOF would wait forever on
+            # an EOF the mux cannot send — stop splicing and fully close.
+            await down
+            try:
+                half_close = writer.can_write_eof()
+            except (OSError, RuntimeError):
+                half_close = False
+            if not half_close:
+                up.cancel()
+            await asyncio.gather(up, down, return_exceptions=True)
         finally:
             await _close(bw)
             await _close(writer)

@@ -176,10 +176,12 @@ def point_is_identity(p, F: FieldOps):
     return F.is_zero(z)
 
 
-def scalar_mul(p, bits, F: FieldOps):
+def scalar_mul_ladder(p, bits, F: FieldOps):
     """p * k, with k given as an MSB-first bit array (..., SCALAR_BITS).
 
     Fixed 256-iteration double-and-select scan; batch axes broadcast.
+    (Kept as the reference ladder; `scalar_mul` below is the faster
+    windowed form.)
     """
     acc0 = point_identity(F, p.shape[: -(F.ndim + 1)])
     # derive from p so the carry picks up p's manual/varying axes under
@@ -194,6 +196,65 @@ def scalar_mul(p, bits, F: FieldOps):
         return acc, None
 
     out, _ = lax.scan(step, acc0, bits_t)
+    return out
+
+
+MUL_WINDOW = 4
+
+
+def point_table(p, F: FieldOps, window: int = MUL_WINDOW):
+    """Multiples T[v] = v*p for v in [0, 2^w): (2^w, ..., 3, *field)."""
+    ident = jnp.broadcast_to(point_identity(F), p.shape).astype(p.dtype)
+    entries = [ident, p]
+    for v in range(2, 1 << window):
+        if v % 2 == 0:
+            entries.append(point_double(entries[v // 2], F))
+        else:
+            entries.append(point_add(entries[v - 1], p, F))
+    return jnp.stack(entries, 0)
+
+
+def scalar_digits(bits, window: int = MUL_WINDOW):
+    """MSB-first bit array (..., SCALAR_BITS) -> (..., nwin) base-2^w
+    digits (MSB window first).  Shared by scalar_mul and ops.msm."""
+    nwin = SCALAR_BITS // window
+    weights = jnp.asarray(
+        [1 << (window - 1 - i) for i in range(window)], dtype=jnp.int32
+    )
+    return (
+        bits.reshape(*bits.shape[:-1], nwin, window).astype(jnp.int32)
+        * weights
+    ).sum(-1)
+
+
+def scalar_mul(p, bits, F: FieldOps):
+    """p * k via fixed 4-bit windows: 14 table ops + 256 doubles + 64
+    selected adds, vs 256 doubles + 256 selected adds for the plain
+    ladder (~40% fewer point ops).  The window digit picks its table
+    entry with a one-hot masked sum — no data-dependent gathers.
+
+    bits: MSB-first (..., SCALAR_BITS); batch axes broadcast with p's.
+    """
+    w = MUL_WINDOW
+    tab = point_table(p, F, w)                       # (16, ..., 3, f)
+    digits = scalar_digits(bits, w)                  # (..., nwin)
+    digits_t = jnp.moveaxis(digits, -1, 0)           # (nwin, ...)
+
+    acc0 = point_identity(F, p.shape[: -(F.ndim + 1)])
+    acc0 = point_select(jnp.zeros((), dtype=bool), p, acc0, F)
+
+    def step(acc, d):
+        for _ in range(w):
+            acc = point_double(acc, F)
+        onehot = (
+            d[..., None] == jnp.arange(1 << w, dtype=jnp.int32)
+        ).astype(tab.dtype)                          # (..., 16)
+        oh = jnp.moveaxis(onehot, -1, 0)             # (16, ...)
+        oh = oh.reshape(oh.shape + (1,) * (F.ndim + 1))
+        chosen = (tab * oh).sum(0)                   # exact: one-hot
+        return point_add(acc, chosen, F), None
+
+    out, _ = lax.scan(step, acc0, digits_t)
     return out
 
 

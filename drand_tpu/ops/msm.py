@@ -38,40 +38,18 @@ from drand_tpu.ops.curve import (
     F1,
     F2,
     FieldOps,
+    MUL_WINDOW as WINDOW,
     SCALAR_BITS,
     point_add,
     point_double,
     point_identity,
     point_select,
+    point_table,
+    scalar_digits,
 )
 
-WINDOW = 4
 NDIGITS = SCALAR_BITS // WINDOW          # 64 base-16 digits
 TABLE = 1 << WINDOW                      # 16 table entries
-
-
-def _digits(bits, window=WINDOW):
-    """MSB-first bit array (B, 256) -> (B, NDIGITS) base-2^w digits."""
-    b = bits.shape[0]
-    w = bits.reshape(b, SCALAR_BITS // window, window)
-    weights = jnp.asarray(
-        [1 << (window - 1 - i) for i in range(window)], dtype=jnp.int32
-    )
-    return (w.astype(jnp.int32) * weights).sum(-1)
-
-
-def _table(points, F: FieldOps):
-    """Per-point multiples T[v] = v*P, v in [0, 16): (16, B, 3, ...)."""
-    ident = jnp.broadcast_to(
-        point_identity(F), points.shape
-    ).astype(points.dtype)
-    entries = [ident, points]
-    for v in range(2, TABLE):
-        if v % 2 == 0:
-            entries.append(point_double(entries[v // 2], F))
-        else:
-            entries.append(point_add(entries[v - 1], points, F))
-    return jnp.stack(entries, 0)
 
 
 def _window_sums(points, bits, F: FieldOps):
@@ -82,8 +60,8 @@ def _window_sums(points, bits, F: FieldOps):
     pairwise tree — each tree level is ONE point_add over all 64 window
     columns at the current width.
     """
-    tab = _table(points, F)                       # (16, B, 3, ...)
-    digits = _digits(bits)                        # (B, 64)
+    tab = point_table(points, F)                  # (16, B, 3, ...)
+    digits = scalar_digits(bits)                  # (B, 64)
     onehot = (
         digits[..., None] == jnp.arange(TABLE, dtype=jnp.int32)
     ).astype(tab.dtype)                           # (B, 64, 16)

@@ -101,6 +101,26 @@ async def test_mux_tls_single_port(tmp_path):
         with pytest.raises(Exception):
             await asyncio.wait_for(stranger.home(peer), 10)
         await stranger.close()
+
+        # a browser-like client offering BOTH h2 and http/1.1 must land
+        # on the REST plane: server preference http/1.1-first makes
+        # OpenSSL pick http/1.1 even though the client prefers h2 (gRPC
+        # clients offer only h2 and keep working)
+        browser_ctx = ssl.create_default_context()
+        browser_ctx.load_verify_locations(cadata=cert_pem.decode())
+        browser_ctx.set_alpn_protocols(["h2", "http/1.1"])
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", port, ssl=browser_ctx,
+            server_hostname="127.0.0.1",
+        )
+        assert writer.get_extra_info("ssl_object") \
+            .selected_alpn_protocol() == "http/1.1"
+        writer.write(b"GET /web HTTP/1.1\r\nHost: x\r\n"
+                     b"Connection: close\r\n\r\n")
+        await writer.drain()
+        body = await asyncio.wait_for(reader.read(), 15)
+        assert b"200 OK" in body and b"drand-tpu" in body
+        writer.close()
     finally:
         await mux.cleanup()
         await runner.cleanup()
