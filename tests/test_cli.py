@@ -85,7 +85,9 @@ def test_daemon_lifecycle_and_dkg(tmp_path):
     group_file = tmp_path / "group.toml"
     # 30s period: four pure-Python daemons + polling subprocesses
     # share one core; 10s rounds starve and get ticker-cancelled forever
-    genesis = int(time.time()) + 60
+    # 120s to genesis: the DKG below must certify on EVERY node first,
+    # and four real daemons on one core can take >60s wall for that
+    genesis = int(time.time()) + 120
     r = run_cli(
         ["group", *map(str, pubs), "--period", "30s",
          "--genesis", str(genesis), "--out", str(group_file)],
@@ -123,7 +125,7 @@ def test_daemon_lifecycle_and_dkg(tmp_path):
                 [sys.executable, "-m", "drand_tpu.cli",
                  "--folder", str(folders[i]),
                  "--control", str(ctrl_ports[i]),
-                 "share", str(group_file)],
+                 "share", str(group_file), "--timeout", "100"],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True, env=env_i,
             ))
@@ -131,7 +133,7 @@ def test_daemon_lifecycle_and_dkg(tmp_path):
         lead = subprocess.run(
             [sys.executable, "-m", "drand_tpu.cli",
              "--folder", str(folders[0]), "--control", str(ctrl_ports[0]),
-             "share", str(group_file), "--leader"],
+             "share", str(group_file), "--leader", "--timeout", "100"],
             capture_output=True, text=True, timeout=180, env=env,
         )
         assert lead.returncode == 0, lead.stdout + lead.stderr
