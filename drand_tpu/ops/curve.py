@@ -97,7 +97,117 @@ def _mulw(F: FieldOps, pairs):
     return [jnp.take(m, i, axis=ax) for i in range(len(pairs))]
 
 
+# --------------------------------------------------------------------------
+# Lazy-reduction machinery: each "wave" of independent field products is
+# recorded, executed as ONE stacked unreduced `fp.mul_wide`, combined
+# symbolically (integer-coefficient adds/subs at trace time), and
+# Montgomery-reduced ONCE per *output* value rather than once per product
+# (same design as ops/tower.py's pairing path — see the block comment
+# there).  For Fp2, Karatsuba needs 3 products but only 2 REDCs; linear
+# combinations of products (RCB16's t3/t4/y3 and the final x3/y3/z3)
+# cost no extra REDC at all.
+# --------------------------------------------------------------------------
+
+
+class _LazyWave:
+    """One wave of products over a single FieldOps (Fp or Fp2)."""
+
+    def __init__(self, F: FieldOps):
+        self.F = F
+        self.rec = tower._Rec()
+
+    def mul(self, a, b):
+        """Concrete x concrete -> symbolic product (field element)."""
+        if self.F.ndim == 1:
+            return self.rec.prod(a, b)
+        return self.rec.fp2_mul(a, b)
+
+    def add(self, x, y):
+        if self.F.ndim == 1:
+            return x + y
+        return tower._sp_add(x, y)
+
+    def sub(self, x, y):
+        if self.F.ndim == 1:
+            return x - y
+        return tower._sp_sub(x, y)
+
+    def sqr(self, a):
+        if self.F.ndim == 1:
+            return self.rec.prod(a, a)
+        return self.rec.fp2_sqr(a)
+
+    def muls(self, x, k: int):
+        if self.F.ndim == 1:
+            return x.muls(k)
+        return (x[0].muls(k), x[1].muls(k))
+
+    def materialize(self, syms):
+        """Reduce the requested symbolic outputs; one REDC each.
+
+        Returns concrete field elements, same order as `syms`.
+        """
+        if self.F.ndim == 1:
+            flat = list(syms)
+        else:
+            flat = [c for s in syms for c in s]
+        out = self.rec.materialize(flat)    # (..., len(flat), NLIMB)
+        if self.F.ndim == 1:
+            return [out[..., i, :] for i in range(len(syms))]
+        return [
+            jnp.stack(
+                [out[..., 2 * i, :], out[..., 2 * i + 1, :]], axis=-2
+            )
+            for i in range(len(syms))
+        ]
+
+
 def point_add(p, q, F: FieldOps):
+    """Complete addition (RCB16 Algorithm 7, a=0), lazy reduction.
+
+    Three product waves with one REDC per needed output value: 22 REDCs
+    on Fp2 instead of the eager 42 (products unchanged), ~25% less field
+    work per G2 addition.
+    """
+    x1, y1, z1 = _xyz(p, F)
+    x2, y2, z2 = _xyz(q, F)
+    b3 = jnp.broadcast_to(jnp.asarray(F.b3), x1.shape)
+
+    w1 = _LazyWave(F)
+    m_t0 = w1.mul(x1, x2)
+    m_t1 = w1.mul(y1, y2)
+    m_t2 = w1.mul(z1, z2)
+    m_t3 = w1.mul(F.add(x1, y1), F.add(x2, y2))
+    m_t4 = w1.mul(F.add(y1, z1), F.add(y2, z2))
+    m_x3 = w1.mul(F.add(x1, z1), F.add(x2, z2))
+    t0, t1, t2, t3, t4, y3 = w1.materialize([
+        m_t0, m_t1, m_t2,
+        w1.sub(m_t3, w1.add(m_t0, m_t1)),
+        w1.sub(m_t4, w1.add(m_t1, m_t2)),
+        w1.sub(m_x3, w1.add(m_t0, m_t2)),
+    ])
+    x3 = F.add(t0, t0)
+    t0 = F.add(x3, t0)
+
+    w2 = _LazyWave(F)
+    t2b, y3b = w2.materialize([w2.mul(b3, t2), w2.mul(b3, y3)])
+    z3 = F.add(t1, t2b)
+    t1 = F.sub(t1, t2b)
+
+    w3 = _LazyWave(F)
+    m0 = w3.mul(t4, y3b)
+    m1 = w3.mul(t3, t1)
+    m2 = w3.mul(y3b, t0)
+    m3 = w3.mul(t1, z3)
+    m4 = w3.mul(t0, t3)
+    m5 = w3.mul(z3, t4)
+    x3, y3, z3 = w3.materialize([
+        w3.sub(m1, m0), w3.add(m3, m2), w3.add(m5, m4),
+    ])
+    return _pack(x3, y3, z3, F)
+
+
+def point_add_eager(p, q, F: FieldOps):
     """Complete addition (RCB16 Algorithm 7, a=0) in 3 mul waves."""
     x1, y1, z1 = _xyz(p, F)
     x2, y2, z2 = _xyz(q, F)
@@ -134,6 +244,42 @@ def point_add(p, q, F: FieldOps):
 
 
 def point_double(p, F: FieldOps):
+    """Complete doubling (RCB16 Algorithm 9, a=0), lazy reduction.
+
+    On Fp2: 25 products + 16 REDCs, vs the eager form's 27 + 27 (the
+    eager path squares y and z through generic 3-product fp2_muls; here
+    fp2_sqr uses 2, the last two eager waves merge into one — their
+    inputs only depend on wave-2 outputs — and the final x3/y3
+    combinations stay symbolic).
+    """
+    x, y, z = _xyz(p, F)
+    b3 = jnp.broadcast_to(jnp.asarray(F.b3), x.shape)
+
+    w1 = _LazyWave(F)
+    t0, t1, t2, txy = w1.materialize([
+        w1.sqr(y), w1.mul(y, z), w1.sqr(z), w1.mul(x, y),
+    ])
+    z3 = F.add(t0, t0)
+    z3 = F.add(z3, z3)
+    z3 = F.add(z3, z3)                    # 8 * y^2
+
+    w2 = _LazyWave(F)
+    (t2b,) = w2.materialize([w2.mul(b3, t2)])
+    y3 = F.add(t0, t2b)
+    t0 = F.sub(t0, F.add(F.add(t2b, t2b), t2b))
+
+    w3 = _LazyWave(F)
+    p1 = w3.mul(t2b, z3)                  # b3 z^2 * 8 y^2
+    p2 = w3.mul(t1, z3)                   # y z * 8 y^2
+    p3 = w3.mul(t0, y3)
+    p4 = w3.mul(t0, txy)
+    x3, y3, z3 = w3.materialize([
+        w3.muls(p4, 2), w3.add(p1, p3), p2,
+    ])
+    return _pack(x3, y3, z3, F)
+
+
+def point_double_eager(p, F: FieldOps):
     """Complete doubling (RCB16 Algorithm 9, a=0) in 3 mul waves."""
     x, y, z = _xyz(p, F)
     b3 = jnp.broadcast_to(jnp.asarray(F.b3), x.shape)

@@ -74,3 +74,35 @@ def test_point_eq():
     assert not bool(curve.g1_eq(a, b))
     assert bool(curve.g1_eq(curve.g1_identity(), curve.g1_identity()))
     assert not bool(curve.g1_eq(a, curve.g1_identity()))
+
+
+def test_lazy_point_ops_match_eager():
+    """The lazy-reduction point_add/point_double must stay bit-identical
+    (as group elements) to the eager RCB16 reference implementations —
+    pins the two copies together so neither silently drifts."""
+    import random
+
+    from drand_tpu.ops.curve import (
+        F1,
+        F2,
+        point_add,
+        point_add_eager,
+        point_double,
+        point_double_eager,
+        point_eq,
+    )
+
+    rng = random.Random(99)
+    for F, gen, mul, enc in (
+        (F1, ref.G1_GEN, ref.g1_mul, curve.g1_encode),
+        (F2, ref.G2_GEN, ref.g2_mul, curve.g2_encode),
+    ):
+        for trial in range(3):
+            a = enc(mul(gen, rng.randrange(1, ref.R)))
+            b = enc(mul(gen, rng.randrange(1, ref.R)))
+            assert bool(point_eq(
+                point_add(a, b, F), point_add_eager(a, b, F), F
+            ))
+            assert bool(point_eq(
+                point_double(a, F), point_double_eager(a, F), F
+            ))
