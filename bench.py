@@ -58,6 +58,35 @@ def _backend_alive(timeout: float) -> bool:
         return False
 
 
+def _supervise() -> None:
+    """Run the bench in a child process; if the child dies on a
+    memory-fault signal (SIGSEGV/SIGILL/SIGBUS — observed when a
+    persistent-XLA-cache entry written under different CPU features
+    deserializes badly), retry ONCE with the compilation cache disabled.
+    Other signals (OOM SIGKILL, external SIGTERM) are NOT retried — a
+    cold recompile would only make those worse.  Exits with the child's
+    code."""
+    import signal
+
+    if os.environ.get("BENCH_SUPERVISED") == "1":
+        return
+    env = dict(os.environ)
+    env["BENCH_SUPERVISED"] = "1"
+    r = subprocess.run([sys.executable] + sys.argv, env=env)
+    fault_sigs = {signal.SIGSEGV, signal.SIGILL, signal.SIGBUS}
+    if r.returncode < 0 and -r.returncode in fault_sigs:
+        # mark the stream so a consumer can tell retried records from
+        # the crashed attempt's partial output
+        print(json.dumps({
+            "config": "_retry",
+            "reason": f"child died on signal {-r.returncode}; "
+                      "retrying with the XLA cache disabled",
+        }), flush=True)
+        env["DRAND_TPU_XLA_CACHE"] = "off"
+        r = subprocess.run([sys.executable] + sys.argv, env=env)
+    sys.exit(r.returncode)
+
+
 def _maybe_fallback_to_cpu() -> None:
     """Re-exec with a forced CPU backend (and a batch sized for a 1-core
     host) when the ambient backend is dead.  Runs before any jax import
@@ -197,6 +226,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    _supervise()
     _maybe_fallback_to_cpu()
     try:
         main()

@@ -241,7 +241,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    from bench import _maybe_fallback_to_cpu
+    from bench import _maybe_fallback_to_cpu, _supervise
 
+    _supervise()
     _maybe_fallback_to_cpu()
     main()

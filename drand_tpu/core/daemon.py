@@ -241,6 +241,16 @@ class Drand:
             else:  # aiohttp runner
                 await s.cleanup()
         await self._client.close()
+        # release the chain store LAST — only after the servers are down
+        # can no in-flight RPC reach it (the native backend would pass a
+        # NULL handle into C); closing it at all matters because the
+        # native backend holds the single-writer flock until closed, so
+        # a same-process restart (Drand.load) would otherwise be locked
+        # out
+        self.beacon = None
+        if self._beacon_store is not None:
+            self._beacon_store.close()
+            self._beacon_store = None
         self._exit.set()
 
     def request_shutdown(self) -> None:
