@@ -23,6 +23,15 @@ WORKDIR /opt/drand_tpu
 COPY drand_tpu/ drand_tpu/
 COPY README.md .
 
+# Build the native C++ crypto backend and pre-populate the persistent XLA
+# compile cache for the daemon's standard kernel shapes at image build
+# time, so the first verify of a fresh container is milliseconds, not a
+# multi-minute cold compile (`drand-tpu warmup`).
+RUN python -c "from drand_tpu.crypto import native_bls; \
+    assert native_bls.available(), 'native BLS build failed'; \
+    assert native_bls.selfcheck() == 0" \
+    && python -m drand_tpu.cli warmup
+
 # public gRPC port / REST gateway / localhost control
 EXPOSE 8080 8081
 VOLUME /data

@@ -2,7 +2,20 @@
 
 PY ?= python
 
-.PHONY: test test-slow bench bench-suite integration demo clean
+.PHONY: test test-slow bench bench-suite integration demo warmup \
+	compose-test compose-test-tls clean
+
+# pre-compile device kernels into the persistent XLA cache
+warmup:
+	$(PY) -m drand_tpu.cli warmup
+
+# containerised integration networks (reference
+# test/test-integration/docker_test.sh: notls + tls variants)
+compose-test:
+	deploy/compose/run.sh notls
+
+compose-test-tls:
+	deploy/compose/run.sh tls
 
 test:
 	$(PY) -m pytest tests/ -x -q

@@ -27,6 +27,9 @@ Environment knobs:
   BENCH_DEVICE_ONLY  "1": skip hashing, time the pairing check alone
   BENCH_PROBE_TIMEOUT  seconds to wait for the ambient JAX backend
                        before falling back to CPU (default 240)
+  BENCH_PROFILE_DIR  write a JAX profiler trace of the timed iterations
+                     here (inspect with xprof/tensorboard) — the
+                     per-kernel breakdown VERDICT r3 asked for
 
 If the ambient accelerator backend is broken (the axon TPU tunnel can
 either raise at init or hang indefinitely — BENCH_r02 recorded rc=1 with
@@ -196,12 +199,22 @@ def main() -> None:
     if not ok.all():
         raise RuntimeError("verification failed in warmup")
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = (verify_e2e(msgs) if not device_only
-               else verify_device_only(q2_fixed))
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+    if profile_dir:
+        # profiling.profile_span reads DRAND_TPU_PROFILE_DIR
+        os.environ["DRAND_TPU_PROFILE_DIR"] = profile_dir
+    from drand_tpu.utils.profiling import profile_span
+
+    # the span wraps the loop but dt is captured INSIDE it, before
+    # stop_trace serializes the trace to disk — profiling must not
+    # deflate the recorded throughput
+    with profile_span("bench-verify"):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = (verify_e2e(msgs) if not device_only
+                   else verify_device_only(q2_fixed))
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
 
     rounds_per_sec = batch * iters / dt
     pairings_per_sec = 2 * rounds_per_sec

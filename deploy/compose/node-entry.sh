@@ -1,0 +1,79 @@
+#!/bin/bash
+# Container entrypoint for the docker-compose integration networks
+# (reference: test/test-integration/*/data/client-script.sh).
+#
+# Each node: generates its keypair, publishes its public key (and, in the
+# TLS variant, a self-signed cert) onto the shared /shared volume, waits
+# for the full committee, boots the daemon, and joins the DKG
+# (followers first, leader last — reference core/control.go:20).
+#
+# Environment:
+#   NODE_INDEX  1..N           this node's index (node1 is the leader)
+#   NODES       N              committee size
+#   PORT        gRPC port      (REST is PORT+1)
+#   TLS         0|1            TLS-everywhere variant
+set -euo pipefail
+
+: "${NODE_INDEX:?}" "${NODES:?}" "${PORT:=8080}" "${TLS:=0}"
+HOST="node${NODE_INDEX}"
+ADDR="${HOST}:${PORT}"
+SHARED=/shared
+FOLDER=/data
+REST_PORT=$((PORT + 1))
+CLI=(python -m drand_tpu.cli --folder "$FOLDER")
+
+log() { echo "[entry ${HOST}] $*"; }
+
+mkdir -p "$SHARED/keys" "$SHARED/certs"
+
+gen_tls_args=()
+start_tls_args=()
+if [ "$TLS" = "1" ]; then
+    # self-signed cert with the service-name SAN; peers trust via the
+    # shared certs dir (reference net/certs.go CertManager pool)
+    python - <<PY
+from drand_tpu.net.tls import generate_self_signed
+cert, key = generate_self_signed("${HOST}")
+open("${FOLDER}/tls.crt", "wb").write(cert)
+open("${FOLDER}/tls.key", "wb").write(key)
+open("${SHARED}/certs/${HOST}.pem", "wb").write(cert)
+PY
+    gen_tls_args=(--tls)
+    start_tls_args=(--tls-cert "$FOLDER/tls.crt" --tls-key "$FOLDER/tls.key"
+                    --certs-dir "$SHARED/certs")
+fi
+
+"${CLI[@]}" generate-keypair "${gen_tls_args[@]}" "$ADDR"
+cp "$FOLDER/key/public.toml" "$SHARED/keys/${HOST}.toml"
+
+log "waiting for $NODES public keys"
+while [ "$(ls "$SHARED/keys" | wc -l)" -lt "$NODES" ]; do sleep 1; done
+if [ "$TLS" = "1" ]; then
+    while [ "$(ls "$SHARED/certs" | wc -l)" -lt "$NODES" ]; do sleep 1; done
+fi
+
+if [ "$NODE_INDEX" = "1" ]; then
+    # leader assembles the group: genesis far enough out that the DKG
+    # (CPU-bound deals on a shared host) lands inside the window
+    "${CLI[@]}" group "$SHARED"/keys/*.toml \
+        --period "${PERIOD:-30s}" --genesis "$(( $(date +%s) + 120 ))" \
+        --out "$SHARED/group.toml.tmp"
+    mv "$SHARED/group.toml.tmp" "$SHARED/group.toml"
+else
+    while [ ! -f "$SHARED/group.toml" ]; do sleep 1; done
+fi
+
+"${CLI[@]}" start --listen "0.0.0.0:${PORT}" --rest-port "$REST_PORT" \
+    "${start_tls_args[@]}" &
+DAEMON=$!
+sleep 3
+
+if [ "$NODE_INDEX" = "1" ]; then
+    # leader last: give followers a head start to register
+    sleep 6
+    "${CLI[@]}" share "$SHARED/group.toml" --leader --timeout 240
+else
+    "${CLI[@]}" share "$SHARED/group.toml" --timeout 240
+fi
+log "DKG done; serving"
+wait "$DAEMON"

@@ -24,9 +24,11 @@ import time
 from pathlib import Path
 
 # the protocol tier is scheme-agnostic; default the subprocess daemons to
-# the pure-Python backend so the integration run doesn't pay device
-# kernel compiles (the device path is covered by bench.py / tests)
-os.environ.setdefault("DRAND_TPU_BACKEND", "ref")
+# the native C++ backend: no device-kernel compiles (the device path is
+# covered by bench.py / tests) and millisecond verifies instead of the
+# oracle's 10s-per-pairing (falls back to the oracle if the lib can't
+# build)
+os.environ.setdefault("DRAND_TPU_BACKEND", "native")
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 

@@ -7,6 +7,8 @@ and daemon.go/control.go/public.go):
   drand-tpu group <key files...>           build a group.toml
   drand-tpu check-group <group.toml>       probe reachability of all nodes
   drand-tpu start                          run the daemon
+  drand-tpu warmup                         pre-compile device kernels into
+                                           the persistent XLA cache
   drand-tpu stop                           stop via the control port
   drand-tpu share <group.toml> [--leader]  run the DKG (or reshare with
                                            --from-group)
@@ -167,6 +169,87 @@ def cmd_start(args) -> int:
         await daemon.wait_exit()
 
     asyncio.run(run())
+    return 0
+
+
+def cmd_warmup(args) -> int:
+    """Pre-populate the persistent XLA compile cache for the daemon's
+    standard kernel shapes, so a fresh deployment's first verify doesn't
+    stall for minutes on a cold Pallas/XLA compile.
+
+    Exercises exactly the jit entry points the daemon hits (same shape
+    buckets as JaxScheme): batched hashed chain verify, partial-flood
+    verify, device sign, and MSM recovery at each requested threshold.
+    The reference has no equivalent because Go compiles ahead of time;
+    this is the TPU-native answer to the same operational need.
+    """
+    import subprocess
+    import time as _time
+
+    # A broken ambient accelerator backend can raise OR hang inside JAX
+    # init; probe it in a subprocess (same self-healing contract as
+    # bench.py) and warm the CPU op-graph path instead when it's dead —
+    # a daemon on the same host will make the same auto fallback.
+    if os.environ.get("DRAND_TPU_WARMUP_FALLBACK") != "1" \
+            and os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", "120")),
+                capture_output=True,
+            )
+            alive = probe.returncode == 0
+        except (subprocess.TimeoutExpired, OSError):
+            alive = False
+        if not alive:
+            print("warmup: ambient accelerator backend is broken; "
+                  "warming the CPU path", flush=True)
+            env = dict(os.environ)
+            env["DRAND_TPU_WARMUP_FALLBACK"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            # re-exec via -m: under `python -m drand_tpu.cli` sys.argv[0]
+            # is this file's path, and exec'ing it as a script would lose
+            # the cwd import root the package is loaded from
+            os.execve(
+                sys.executable,
+                [sys.executable, "-m", "drand_tpu.cli"] + sys.argv[1:],
+                env,
+            )
+
+    from drand_tpu.crypto import refimpl as ref
+    from drand_tpu.crypto import tbls
+    from drand_tpu.crypto.poly import PriPoly
+
+    t0 = _time.monotonic()
+    print("warmup: initializing device backend ...", flush=True)
+    scheme = tbls.JaxScheme()
+    thresholds = sorted(set(args.thresholds or [2, 3]))
+    poly = PriPoly.random(max(thresholds))
+    pub = poly.commit()
+    pk = pub.commit()
+    sk = poly.secret()
+    msg = b"drand-tpu warmup"
+    sig = ref.g2_to_bytes(ref.g2_mul(ref.hash_to_g2(msg), sk))
+
+    def step(label, fn):
+        t = _time.monotonic()
+        fn()
+        print(f"warmup: {label}: {_time.monotonic() - t:.1f}s", flush=True)
+
+    # one batch <= the kernel block compiles the whole verify pipeline
+    step("chain verify kernel (hashed pairing product)",
+         lambda: scheme.verify_chain_batch(pk, [msg], [sig]))
+    step("device sign (h2c + G2 scalar mult)",
+         lambda: scheme.partial_sign(poly.eval(0), msg))
+    for t in thresholds:
+        shares = [poly.eval(i) for i in range(t)]
+        partials = [scheme.partial_sign(s, msg) for s in shares]
+        step(f"partial flood verify (t={t})",
+             lambda: scheme.verify_partials_batch(pub, msg, partials))
+        step(f"MSM recovery (t={t})",
+             lambda: scheme.recover(pub, msg, partials, t, t))
+    print(f"warmup: done in {_time.monotonic() - t0:.1f}s")
     return 0
 
 
@@ -366,18 +449,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory of PEM roots to trust when dialing "
                         "TLS peers")
     env_backend = os.environ.get("DRAND_TPU_BACKEND", "auto")
-    if env_backend not in ("auto", "ref", "jax"):
+    if env_backend not in ("auto", "ref", "jax", "native"):
         raise SystemExit(
-            f"DRAND_TPU_BACKEND={env_backend!r}: must be auto, ref or jax"
+            f"DRAND_TPU_BACKEND={env_backend!r}: must be auto, ref, jax "
+            "or native"
         )
     g.add_argument(
-        "--backend", choices=["auto", "ref", "jax"],
+        "--backend", choices=["auto", "ref", "jax", "native"],
         default=env_backend,
         help="crypto backend: auto = device kernels when an accelerator "
-             "is present (default; DRAND_TPU_BACKEND overrides), "
+             "is present, C++ host backend otherwise (default; "
+             "DRAND_TPU_BACKEND overrides); native = C++ host backend; "
              "ref = pure-Python oracle",
     )
     g.set_defaults(fn=cmd_start)
+
+    g = sub.add_parser("warmup")
+    g.add_argument(
+        "--threshold", dest="thresholds", type=int, action="append",
+        help="warm the MSM/flood kernels for this committee threshold "
+             "(repeatable; default 2 and 3)",
+    )
+    g.set_defaults(fn=cmd_warmup)
 
     g = sub.add_parser("stop")
     g.set_defaults(fn=cmd_stop)

@@ -1,0 +1,203 @@
+"""ctypes wrapper for the native C++ BLS12-381 backend (native/bls.cc).
+
+This is the host-side fast path the blueprint mandates (SURVEY.md §2: a C++
+equivalent, not a Python stand-in, wherever the TPU can't run).  It mirrors
+the reference daemon's native crypto suite (/root/reference/key/curve.go:12)
+for the no-accelerator case: single partial verify ~10 ms instead of the
+pure-Python oracle's 10-30 s.
+
+Everything crosses the boundary as the wire formats the protocol already
+uses (48/96-byte compressed points, 32-byte big-endian scalars), so there
+is no per-op bignum marshalling.  Semantics are byte-identical to
+crypto/refimpl.py — enforced by tests/test_native_bls.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_FAILED = False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, built on demand; None if unavailable."""
+    global _LIB, _LOAD_FAILED
+    if _LIB is not None or _LOAD_FAILED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _LOAD_FAILED:
+            return _LIB
+        from drand_tpu import native
+
+        path = native.shared_lib("bls")
+        if path is None:
+            _LOAD_FAILED = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            _LOAD_FAILED = True
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        c = ctypes.c_char_p
+        u64 = ctypes.c_uint64
+        i32 = ctypes.c_int
+        lib.dbls_init.restype = i32
+        lib.dbls_selfcheck.restype = i32
+        lib.dbls_hash_to_g2.argtypes = [c, u64, u8p]
+        lib.dbls_hash_to_g1.argtypes = [c, u64, u8p]
+        lib.dbls_sign.argtypes = [c, u64, c, u8p]
+        lib.dbls_verify.argtypes = [c, c, u64, c]
+        lib.dbls_verify_pre.argtypes = [c, c, c]
+        lib.dbls_g1_msm.argtypes = [c, c, u64, i32, u8p]
+        lib.dbls_g2_msm.argtypes = [c, c, u64, i32, u8p]
+        lib.dbls_g1_mul.argtypes = [c, c, u8p]
+        lib.dbls_g2_mul.argtypes = [c, c, u8p]
+        lib.dbls_g1_check.argtypes = [c]
+        lib.dbls_g2_check.argtypes = [c]
+        lib.dbls_g1_add.argtypes = [c, c, u8p]
+        lib.dbls_g2_add.argtypes = [c, c, u8p]
+        lib.dbls_pairing.argtypes = [c, c, u8p]
+        for fn in ("dbls_hash_to_g2", "dbls_hash_to_g1", "dbls_sign",
+                   "dbls_verify", "dbls_verify_pre", "dbls_g1_msm",
+                   "dbls_g2_msm", "dbls_g1_mul", "dbls_g2_mul",
+                   "dbls_g1_check", "dbls_g2_check", "dbls_g1_add",
+                   "dbls_g2_add", "dbls_pairing"):
+            getattr(lib, fn).restype = i32
+        if lib.dbls_init() != 0:
+            _LOAD_FAILED = True
+            return None
+        _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# -- thin typed helpers (bytes in, bytes out) --------------------------------
+
+
+def _buf(n: int):
+    return (ctypes.c_uint8 * n)()
+
+
+def hash_to_g2(msg: bytes) -> bytes:
+    lib = load()
+    out = _buf(96)
+    rc = lib.dbls_hash_to_g2(msg, len(msg), out)
+    if rc != 0:
+        raise RuntimeError(f"dbls_hash_to_g2: {rc}")
+    return bytes(out)
+
+
+def hash_to_g1(msg: bytes) -> bytes:
+    lib = load()
+    out = _buf(48)
+    rc = lib.dbls_hash_to_g1(msg, len(msg), out)
+    if rc != 0:
+        raise RuntimeError(f"dbls_hash_to_g1: {rc}")
+    return bytes(out)
+
+
+def sign(msg: bytes, scalar: int) -> bytes:
+    lib = load()
+    out = _buf(96)
+    rc = lib.dbls_sign(msg, len(msg), scalar.to_bytes(32, "big"), out)
+    if rc != 0:
+        raise RuntimeError(f"dbls_sign: {rc}")
+    return bytes(out)
+
+
+def verify(pk48: bytes, msg: bytes, sig96: bytes) -> int:
+    """1 valid, 0 invalid, <0 malformed encodings."""
+    return load().dbls_verify(pk48, msg, len(msg), sig96)
+
+
+def verify_pre(pk48: bytes, hm96: bytes, sig96: bytes) -> int:
+    return load().dbls_verify_pre(pk48, hm96, sig96)
+
+
+def g1_msm(points48: list, scalars: list, check: bool = True) -> bytes:
+    lib = load()
+    out = _buf(48)
+    sc = b"".join(s.to_bytes(32, "big") for s in scalars)
+    rc = lib.dbls_g1_msm(b"".join(points48), sc, len(points48),
+                         1 if check else 0, out)
+    if rc != 0:
+        raise ValueError(f"dbls_g1_msm: {rc}")
+    return bytes(out)
+
+
+def g2_msm(points96: list, scalars: list, check: bool = True) -> bytes:
+    lib = load()
+    out = _buf(96)
+    sc = b"".join(s.to_bytes(32, "big") for s in scalars)
+    rc = lib.dbls_g2_msm(b"".join(points96), sc, len(points96),
+                         1 if check else 0, out)
+    if rc != 0:
+        raise ValueError(f"dbls_g2_msm: {rc}")
+    return bytes(out)
+
+
+def g1_mul(point48: Optional[bytes], scalar: int) -> bytes:
+    """scalar * point (None -> G1 generator)."""
+    lib = load()
+    out = _buf(48)
+    rc = lib.dbls_g1_mul(point48, scalar.to_bytes(32, "big"), out)
+    if rc != 0:
+        raise ValueError(f"dbls_g1_mul: {rc}")
+    return bytes(out)
+
+
+def g2_mul(point96: Optional[bytes], scalar: int) -> bytes:
+    lib = load()
+    out = _buf(96)
+    rc = lib.dbls_g2_mul(point96, scalar.to_bytes(32, "big"), out)
+    if rc != 0:
+        raise ValueError(f"dbls_g2_mul: {rc}")
+    return bytes(out)
+
+
+def g1_add(a48: bytes, b48: bytes) -> bytes:
+    lib = load()
+    out = _buf(48)
+    rc = lib.dbls_g1_add(a48, b48, out)
+    if rc != 0:
+        raise ValueError(f"dbls_g1_add: {rc}")
+    return bytes(out)
+
+
+def g2_add(a96: bytes, b96: bytes) -> bytes:
+    lib = load()
+    out = _buf(96)
+    rc = lib.dbls_g2_add(a96, b96, out)
+    if rc != 0:
+        raise ValueError(f"dbls_g2_add: {rc}")
+    return bytes(out)
+
+
+def g1_check(p48: bytes) -> int:
+    return load().dbls_g1_check(p48)
+
+
+def g2_check(p96: bytes) -> int:
+    return load().dbls_g2_check(p96)
+
+
+def pairing_bytes(p48: bytes, q96: bytes) -> bytes:
+    """Canonical 576-byte GT — refimpl cross-check hook."""
+    lib = load()
+    out = _buf(576)
+    rc = lib.dbls_pairing(p48, q96, out)
+    if rc != 0:
+        raise ValueError(f"dbls_pairing: {rc}")
+    return bytes(out)
+
+
+def selfcheck() -> int:
+    return load().dbls_selfcheck()

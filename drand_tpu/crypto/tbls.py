@@ -176,6 +176,175 @@ class RefScheme(Scheme):
         return out
 
 
+class NativeScheme(Scheme):
+    """C++ host backend (native/bls.cc via crypto/native_bls.py).
+
+    The no-accelerator fast path SURVEY §2 mandates: the reference daemon
+    runs native crypto everywhere (/root/reference/key/curve.go:12); a
+    CPU-only drand_tpu node uses this backend so one partial verify costs
+    ~10 ms, not the pure-Python oracle's 10-30 s.  All points cross the
+    boundary in the wire encodings the protocol already uses, and the
+    semantics are byte-identical to RefScheme (tests/test_native_bls.py).
+    """
+
+    def __init__(self):
+        from drand_tpu.crypto import native_bls as nb
+
+        if not nb.available():
+            from drand_tpu import native
+
+            raise RuntimeError(
+                f"native BLS backend unavailable: {native.build_error()}"
+            )
+        self._nb = nb
+
+    # -- helpers ----------------------------------------------------------
+
+    _IDENT96 = bytes([0xC0]) + bytes(95)
+
+    def _pub_commits(self, pub: PubPoly) -> List[bytes]:
+        """Serialized commitment points, validated once per PubPoly."""
+        cached = getattr(pub, "_nb_commits", None)
+        if cached is not None:
+            return cached
+        blobs = [ref.g1_to_bytes(c) for c in pub.commits]
+        for b in blobs:
+            if self._nb.g1_check(b) != 0:
+                raise ThresholdError("invalid commitment point")
+        pub._nb_commits = blobs
+        return blobs
+
+    def _eval_pub(self, pub: PubPoly, index: int) -> bytes:
+        """base^{f(index+1)} as 48 bytes via native G1 MSM (Horner weights
+        x^j are cheap host scalars; commits validated by _pub_commits).
+
+        Results are memoized per PubPoly: a daemon verifies the same
+        committee's partials every round, and the degree-t MSM per signer
+        — not the pairing — dominated the flood without the cache."""
+        cache = getattr(pub, "_nb_eval_cache", None)
+        if cache is None:
+            cache = pub._nb_eval_cache = {}
+        hit = cache.get(index)
+        if hit is not None:
+            return hit
+        blobs = self._pub_commits(pub)
+        x = index + 1
+        scalars, acc = [], 1
+        for _ in blobs:
+            scalars.append(acc)
+            acc = acc * x % ref.R
+        out = self._nb.g1_msm(blobs, scalars, check=False)
+        cache[index] = out
+        return out
+
+    def _sig_bytes(self, sig) -> bytes:
+        if isinstance(sig, (bytes, bytearray)):
+            return bytes(sig)
+        return ref.g2_to_bytes(sig)
+
+    # -- single-op protocol-plane API -------------------------------------
+
+    def partial_sign(self, share: PriShare, msg: bytes) -> bytes:
+        with _kernel_seconds["g2_sign"].time():
+            sig = self._nb.sign(msg, share.value)
+        return share.index.to_bytes(INDEX_LEN, "big") + sig
+
+    def verify_partial(self, pub: PubPoly, msg: bytes,
+                       partial: bytes) -> None:
+        if len(partial) != INDEX_LEN + SIG_LEN:
+            raise ThresholdError(
+                f"partial must be {INDEX_LEN + SIG_LEN} bytes, "
+                f"got {len(partial)}"
+            )
+        idx = int.from_bytes(partial[:INDEX_LEN], "big")
+        sig = partial[INDEX_LEN:]
+        if sig == self._IDENT96:
+            raise ThresholdError("identity signature rejected")
+        pk_i = self._eval_pub(pub, idx)
+        with _kernel_seconds["pairing_check"].time():
+            rc = self._nb.verify(pk_i, msg, sig)
+        if rc != 1:
+            raise ThresholdError(f"invalid partial signature from {idx}")
+
+    def recover(self, pub: PubPoly, msg: bytes,
+                partials: Sequence[bytes], t: int, n: int) -> bytes:
+        seen = {}
+        for blob in partials:
+            if len(blob) != INDEX_LEN + SIG_LEN:
+                raise ThresholdError(
+                    f"partial must be {INDEX_LEN + SIG_LEN} bytes, "
+                    f"got {len(blob)}"
+                )
+            idx = int.from_bytes(blob[:INDEX_LEN], "big")
+            sig = blob[INDEX_LEN:]
+            if sig == self._IDENT96 or self._nb.g2_check(sig) != 0:
+                raise ThresholdError("identity signature rejected")
+            if idx not in seen:
+                seen[idx] = sig
+        if len(seen) < t:
+            raise ThresholdError(
+                f"not enough distinct partials: {len(seen)} < {t}"
+            )
+        chosen = sorted(seen.items())[:t]
+        lam = lagrange_basis_at_zero([i for i, _ in chosen])
+        with _kernel_seconds["msm_recover"].time():
+            return self._nb.g2_msm(
+                [sig for _, sig in chosen],
+                [lam[i] for i, _ in chosen],
+                check=False,  # validated above
+            )
+
+    def verify_recovered(self, pub_key, msg: bytes, sig: bytes) -> None:
+        sb = self._sig_bytes(sig)
+        if sb == self._IDENT96:
+            raise ThresholdError("identity signature rejected")
+        pk = ref.g1_to_bytes(pub_key)
+        with _kernel_seconds["pairing_check"].time():
+            rc = self._nb.verify(pk, msg, sb)
+        if rc != 1:
+            raise ThresholdError("invalid recovered signature")
+
+    # -- batch API (sequential native ops; still ~1000x the oracle) -------
+
+    def verify_partials_batch(self, pub: PubPoly, msg: bytes,
+                              partials: Sequence[bytes]) -> List[bool]:
+        hm = self._nb.hash_to_g2(msg)  # hash once for the whole flood
+        out = []
+        with _kernel_seconds["pairing_check"].time():
+            for blob in partials:
+                if len(blob) != INDEX_LEN + SIG_LEN:
+                    out.append(False)
+                    continue
+                idx = int.from_bytes(blob[:INDEX_LEN], "big")
+                sig = blob[INDEX_LEN:]
+                if sig == self._IDENT96:
+                    out.append(False)
+                    continue
+                try:
+                    pk_i = self._eval_pub(pub, idx)
+                except (ThresholdError, ValueError):
+                    out.append(False)
+                    continue
+                out.append(self._nb.verify_pre(pk_i, hm, sig) == 1)
+        return out
+
+    def verify_chain_batch(self, pub_key, msgs, sigs):
+        pk = ref.g1_to_bytes(pub_key)
+        out = []
+        with _kernel_seconds["pairing_check"].time():
+            for msg, sig in zip(msgs, sigs):
+                try:
+                    sb = self._sig_bytes(sig)
+                except (ThresholdError, ValueError):
+                    out.append(False)
+                    continue
+                if sb == self._IDENT96:
+                    out.append(False)
+                    continue
+                out.append(self._nb.verify(pk, msg, sb) == 1)
+        return out
+
+
 class JaxScheme(Scheme):
     """TPU backend: batched pairing checks and MSM recovery.
 
@@ -398,26 +567,49 @@ def _accelerator_present() -> bool:
     return "tpu" in backend or "gpu" in backend or backend == "axon"
 
 
+def _native_scheme_or_ref() -> Scheme:
+    try:
+        return NativeScheme()
+    except RuntimeError as e:
+        # degrading to the oracle costs ~1000x per pairing; a daemon that
+        # then misses its round deadlines must have a visible cause
+        from drand_tpu.utils.logging import get_logger
+
+        get_logger("tbls").warning(
+            "native BLS backend unavailable; falling back to the "
+            "pure-Python oracle", error=str(e),
+        )
+        return RefScheme()
+
+
 def default_scheme(backend: Optional[str] = None) -> Scheme:
     """Process-wide scheme selection.
 
-    'jax'  — device batched kernels;
-    'ref'  — pure-Python oracle;
-    'auto' — JaxScheme when an accelerator is present, RefScheme
-             otherwise (the reference always runs its native crypto
-             suite, /root/reference/key/curve.go:12 — a daemon booted on
-             a TPU host should use the device path with no flags).
+    'jax'    — device batched kernels;
+    'native' — C++ host backend (native/bls.cc);
+    'ref'    — pure-Python oracle;
+    'auto'   — JaxScheme when an accelerator is present, NativeScheme
+               otherwise (the reference always runs its native crypto
+               suite, /root/reference/key/curve.go:12 — a daemon booted
+               on a TPU host should use the device path with no flags,
+               and a CPU-only daemon the C++ path, never the oracle).
 
-    Bare default (no argument, first call) stays 'ref': library users who
-    never asked for a device shouldn't pay a JAX initialization.
+    Bare default (no argument, first call) is the native C++ backend when
+    it builds, the oracle otherwise: library users who never asked for a
+    device shouldn't pay a JAX initialization, but they still deserve
+    millisecond verifies.
     """
     global _DEFAULT
     if backend == "auto":
-        backend = "jax" if _accelerator_present() else "ref"
-    if backend is not None:
-        _DEFAULT = JaxScheme() if backend == "jax" else RefScheme()
-    elif _DEFAULT is None:
+        backend = "jax" if _accelerator_present() else "native"
+    if backend == "jax":
+        _DEFAULT = JaxScheme()
+    elif backend == "native":
+        _DEFAULT = _native_scheme_or_ref()
+    elif backend == "ref":
         _DEFAULT = RefScheme()
+    elif _DEFAULT is None:
+        _DEFAULT = _native_scheme_or_ref()
     return _DEFAULT
 
 

@@ -1,0 +1,1853 @@
+// BLS12-381 host-side native backend (threshold-BLS hot path).
+//
+// SURVEY.md section 2's rule: where something can't run on the TPU it gets a
+// C++ host-side equivalent, not a Python stand-in.  This file is that
+// equivalent for the crypto plane: the reference daemon's pairing suite
+// (selected at /root/reference/key/curve.go:12-30, consumed by
+// /root/reference/beacon/beacon.go:433,488) runs native Go; a CPU-only
+// drand_tpu daemon previously fell back to the pure-Python oracle at
+// 10-30 s per beacon round.  This backend is semantically identical to
+// drand_tpu/crypto/refimpl.py — same tower, same SVDW hash-to-curve with the
+// DRANDTPU-V01 DSTs, same compressed codecs — and is cross-checked
+// byte-for-byte against it in tests/test_native_bls.py.
+//
+// Design notes:
+//  * Fp: 6x64-bit Montgomery (CIOS).  All derived exponents ((p-1)/6,
+//    (p+1)/4, ...) and tower/Frobenius/psi constants are COMPUTED at init
+//    from p and x rather than pasted as magic tables, mirroring
+//    refimpl.py's derive-then-verify ethos; dbls_selfcheck() re-verifies.
+//  * Pairing: optimal ate, homogeneous projective Miller steps with sparse
+//    (c00, c11 w^3, c12 w^5) line multiplication; exact final
+//    exponentiation via hard = d*(x+p)*(x^2+p^2-1)+1, d = (x-1)^2/3 = H1
+//    (verified exactly against refimpl's naive pow in tests).
+//  * Lines are scaled by Fp2 factors only (killed by the p^6-1 easy part),
+//    so GT outputs equal refimpl's exactly.
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC (drand_tpu/native/__init__.py).
+
+#include <cstdint>
+#include <cstring>
+
+typedef uint64_t u64;
+typedef unsigned __int128 u128;
+
+// ---------------------------------------------------------------------------
+// Fp: 6x64 little-endian limbs, Montgomery form (R = 2^384).
+// ---------------------------------------------------------------------------
+
+struct fp { u64 l[6]; };
+
+static const u64 P_L[6] = {
+    0xb9feffffffffaaabULL, 0x1eabfffeb153ffffULL, 0x6730d2a0f6b0f624ULL,
+    0x64774b84f38512bfULL, 0x4b1ba7b6434bacd7ULL, 0x1a0111ea397fe69aULL,
+};
+static const u64 N0_INV = 0x89f3fffcfffcfffdULL;  // -p^-1 mod 2^64
+static const fp R2 = {{  // 2^768 mod p (to-Montgomery factor)
+    0xf4df1f341c341746ULL, 0x0a76e6a609d104f1ULL, 0x8de5476c4c95b6d5ULL,
+    0x67eb88a9939d83c0ULL, 0x9a793e85b519952dULL, 0x11988fe592cae3aaULL,
+}};
+
+// |x| for the BLS parameter x = -0xD201000000010000
+static const u64 X_ABS = 0xD201000000010000ULL;
+
+// scalar field r = x^4 - x^2 + 1 (4x64 LE limbs)
+static const u64 R_L[4] = {
+    0xffffffff00000001ULL, 0x53bda402fffe5bfeULL,
+    0x3339d80809a1d805ULL, 0x73eda753299d7d48ULL,
+};
+
+static inline int fp_cmp_raw(const u64* a, const u64* b, int n) {
+    for (int i = n - 1; i >= 0; --i) {
+        if (a[i] < b[i]) return -1;
+        if (a[i] > b[i]) return 1;
+    }
+    return 0;
+}
+
+static inline u64 add_limbs(u64* r, const u64* a, const u64* b, int n) {
+    u128 c = 0;
+    for (int i = 0; i < n; ++i) {
+        u128 s = (u128)a[i] + b[i] + c;
+        r[i] = (u64)s;
+        c = s >> 64;
+    }
+    return (u64)c;
+}
+
+static inline u64 sub_limbs(u64* r, const u64* a, const u64* b, int n) {
+    u128 borrow = 0;
+    for (int i = 0; i < n; ++i) {
+        u128 d = (u128)a[i] - b[i] - borrow;
+        r[i] = (u64)d;
+        borrow = (d >> 64) & 1;
+    }
+    return (u64)borrow;
+}
+
+static inline void fp_add(fp& r, const fp& a, const fp& b) {
+    u64 t[6];
+    add_limbs(t, a.l, b.l, 6);
+    if (fp_cmp_raw(t, P_L, 6) >= 0) sub_limbs(t, t, P_L, 6);
+    memcpy(r.l, t, sizeof t);
+}
+
+static inline void fp_sub(fp& r, const fp& a, const fp& b) {
+    u64 t[6];
+    if (sub_limbs(t, a.l, b.l, 6)) add_limbs(t, t, P_L, 6);
+    memcpy(r.l, t, sizeof t);
+}
+
+static inline void fp_neg(fp& r, const fp& a) {
+    bool z = true;
+    for (int i = 0; i < 6; ++i) if (a.l[i]) { z = false; break; }
+    if (z) { r = a; return; }
+    sub_limbs(r.l, P_L, a.l, 6);
+}
+
+static inline bool fp_is_zero(const fp& a) {
+    for (int i = 0; i < 6; ++i) if (a.l[i]) return false;
+    return true;
+}
+
+static inline bool fp_eq(const fp& a, const fp& b) {
+    return memcmp(a.l, b.l, sizeof a.l) == 0;
+}
+
+// CIOS Montgomery multiplication.
+static void fp_mul(fp& r, const fp& a, const fp& b) {
+    u64 t[7] = {0, 0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 6; ++i) {
+        u128 c = 0;
+        u64 ai = a.l[i];
+        for (int j = 0; j < 6; ++j) {
+            u128 s = (u128)t[j] + (u128)ai * b.l[j] + c;
+            t[j] = (u64)s;
+            c = s >> 64;
+        }
+        u64 t6 = t[6] + (u64)c;  // cannot overflow: t stays < 2p*2^384
+        u64 m = t[0] * N0_INV;
+        u128 s = (u128)t[0] + (u128)m * P_L[0];
+        c = s >> 64;
+        for (int j = 1; j < 6; ++j) {
+            s = (u128)t[j] + (u128)m * P_L[j] + c;
+            t[j - 1] = (u64)s;
+            c = s >> 64;
+        }
+        s = (u128)t6 + c;
+        t[5] = (u64)s;
+        t[6] = (u64)(s >> 64);
+    }
+    if (t[6] || fp_cmp_raw(t, P_L, 6) >= 0) sub_limbs(t, t, P_L, 6);
+    memcpy(r.l, t, 6 * sizeof(u64));
+}
+
+static inline void fp_sqr(fp& r, const fp& a) { fp_mul(r, a, a); }
+
+static fp FP_ZERO;      // all zero
+static fp FP_ONE_MONT;  // R mod p (Montgomery 1), set in init
+
+static void fp_from_u64(fp& r, u64 v) {
+    fp t = {{v, 0, 0, 0, 0, 0}};
+    fp_mul(r, t, R2);
+}
+
+static void fp_from_mont(u64 out[6], const fp& a) {
+    fp one_raw = {{1, 0, 0, 0, 0, 0}};
+    fp t;
+    fp_mul(t, a, one_raw);
+    memcpy(out, t.l, sizeof t.l);
+}
+
+// canonical big-endian 48 bytes <-> Montgomery fp
+static void fp_to_bytes(uint8_t out[48], const fp& a) {
+    u64 c[6];
+    fp_from_mont(c, a);
+    for (int i = 0; i < 6; ++i)
+        for (int j = 0; j < 8; ++j)
+            out[48 - 8 * (i + 1) + (7 - j)] = (uint8_t)(c[i] >> (8 * j));
+}
+
+static int fp_from_bytes(fp& r, const uint8_t in[48]) {
+    u64 c[6] = {0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 6; ++i)
+        for (int j = 0; j < 8; ++j)
+            c[i] |= (u64)in[48 - 8 * (i + 1) + (7 - j)] << (8 * j);
+    if (fp_cmp_raw(c, P_L, 6) >= 0) return -1;
+    fp t;
+    memcpy(t.l, c, sizeof c);
+    fp_mul(r, t, R2);
+    return 0;
+}
+
+// generic MSB-first pow over limb exponents (nl limbs little-endian)
+static void fp_pow_limbs(fp& r, const fp& base, const u64* e, int nl) {
+    int top = -1;
+    for (int i = nl - 1; i >= 0 && top < 0; --i)
+        if (e[i]) for (int b = 63; b >= 0; --b)
+            if ((e[i] >> b) & 1) { top = i * 64 + b; break; }
+    if (top < 0) { r = FP_ONE_MONT; return; }
+    fp acc = base;
+    for (int k = top - 1; k >= 0; --k) {
+        fp_sqr(acc, acc);
+        if ((e[k / 64] >> (k % 64)) & 1) fp_mul(acc, acc, base);
+    }
+    r = acc;
+}
+
+// derived exponents (set in init from P_L)
+static u64 EXP_P_MINUS_2[6];   // inversion
+static u64 EXP_SQRT[6];        // (p+1)/4
+static u64 EXP_QR[6];          // (p-1)/2
+static u64 EXP_P16[6];         // (p-1)/6  (Frobenius base constant)
+static u64 HALF_P[6];          // (p-1)/2 as plain limbs for sign compare
+static u64 D_EXP[2];           // (x-1)^2/3 = H1 = final-exp d  (126-bit)
+
+static void shr_limbs(u64* a, int n, int k) {  // k in {1,2}
+    for (int i = 0; i < n; ++i) {
+        a[i] >>= k;
+        if (i + 1 < n) a[i] |= a[i + 1] << (64 - k);
+    }
+}
+
+static void div_small(u64* a, int n, u64 d) {
+    u128 rem = 0;
+    for (int i = n - 1; i >= 0; --i) {
+        u128 cur = (rem << 64) | a[i];
+        a[i] = (u64)(cur / d);
+        rem = cur % d;
+    }
+}
+
+static inline void fp_inv(fp& r, const fp& a) {
+    fp_pow_limbs(r, a, EXP_P_MINUS_2, 6);
+}
+
+static bool fp_is_square(const fp& a) {
+    if (fp_is_zero(a)) return true;
+    fp t;
+    fp_pow_limbs(t, a, EXP_QR, 6);
+    return fp_eq(t, FP_ONE_MONT);
+}
+
+static bool fp_sqrt(fp& r, const fp& a) {
+    if (fp_is_zero(a)) { r = FP_ZERO; return true; }
+    fp s, chk;
+    fp_pow_limbs(s, a, EXP_SQRT, 6);
+    fp_sqr(chk, s);
+    if (!fp_eq(chk, a)) return false;
+    r = s;
+    return true;
+}
+
+static int fp_sgn0(const fp& a) {
+    u64 c[6];
+    fp_from_mont(c, a);
+    return (int)(c[0] & 1);
+}
+
+// canonical y > (p-1)/2 ?
+static bool fp_is_high(const fp& a) {
+    u64 c[6];
+    fp_from_mont(c, a);
+    return fp_cmp_raw(c, HALF_P, 6) > 0;
+}
+
+// ---------------------------------------------------------------------------
+// Fp2 = Fp[u]/(u^2+1)
+// ---------------------------------------------------------------------------
+
+struct fp2 { fp c0, c1; };
+
+static fp2 FP2_ZERO_, FP2_ONE_, XI_;  // XI = 1 + u
+
+static inline void fp2_add(fp2& r, const fp2& a, const fp2& b) {
+    fp_add(r.c0, a.c0, b.c0); fp_add(r.c1, a.c1, b.c1);
+}
+static inline void fp2_sub(fp2& r, const fp2& a, const fp2& b) {
+    fp_sub(r.c0, a.c0, b.c0); fp_sub(r.c1, a.c1, b.c1);
+}
+static inline void fp2_neg(fp2& r, const fp2& a) {
+    fp_neg(r.c0, a.c0); fp_neg(r.c1, a.c1);
+}
+static inline void fp2_conj(fp2& r, const fp2& a) {
+    r.c0 = a.c0; fp_neg(r.c1, a.c1);
+}
+static inline bool fp2_is_zero(const fp2& a) {
+    return fp_is_zero(a.c0) && fp_is_zero(a.c1);
+}
+static inline bool fp2_eq(const fp2& a, const fp2& b) {
+    return fp_eq(a.c0, b.c0) && fp_eq(a.c1, b.c1);
+}
+
+static void fp2_mul(fp2& r, const fp2& a, const fp2& b) {
+    // Karatsuba: 3 fp muls
+    fp t0, t1, s0, s1, m;
+    fp_mul(t0, a.c0, b.c0);
+    fp_mul(t1, a.c1, b.c1);
+    fp_add(s0, a.c0, a.c1);
+    fp_add(s1, b.c0, b.c1);
+    fp_mul(m, s0, s1);          // (a0+a1)(b0+b1)
+    fp r0;
+    fp_sub(r0, t0, t1);         // a0b0 - a1b1
+    fp_sub(m, m, t0);
+    fp_sub(r.c1, m, t1);        // a0b1 + a1b0
+    r.c0 = r0;
+}
+
+static void fp2_sqr(fp2& r, const fp2& a) {
+    fp s, d, m;
+    fp_add(s, a.c0, a.c1);
+    fp_sub(d, a.c0, a.c1);
+    fp_mul(m, a.c0, a.c1);
+    fp_mul(r.c0, s, d);
+    fp_add(r.c1, m, m);
+}
+
+static inline void fp2_mul_fp(fp2& r, const fp2& a, const fp& s) {
+    fp_mul(r.c0, a.c0, s); fp_mul(r.c1, a.c1, s);
+}
+
+static inline void fp2_mul_xi(fp2& r, const fp2& a) {
+    // (a0 + a1 u)(1 + u) = (a0 - a1) + (a0 + a1) u
+    fp t0, t1;
+    fp_sub(t0, a.c0, a.c1);
+    fp_add(t1, a.c0, a.c1);
+    r.c0 = t0; r.c1 = t1;
+}
+
+static void fp2_inv(fp2& r, const fp2& a) {
+    fp n, t, i;
+    fp_sqr(n, a.c0);
+    fp_sqr(t, a.c1);
+    fp_add(n, n, t);
+    fp_inv(i, n);
+    fp_mul(r.c0, a.c0, i);
+    fp_mul(t, a.c1, i);
+    fp_neg(r.c1, t);
+}
+
+static bool fp2_is_square(const fp2& a) {
+    fp n, t;
+    fp_sqr(n, a.c0);
+    fp_sqr(t, a.c1);
+    fp_add(n, n, t);
+    return fp_is_square(n);
+}
+
+static bool fp2_sqrt(fp2& r, const fp2& a) {
+    // 'complex' method, mirroring refimpl.fp2_sqrt
+    if (fp_is_zero(a.c1)) {
+        fp s;
+        if (fp_sqrt(s, a.c0)) { r.c0 = s; r.c1 = FP_ZERO; return true; }
+        fp na;
+        fp_neg(na, a.c0);
+        if (!fp_sqrt(s, na)) return false;
+        r.c0 = FP_ZERO; r.c1 = s;
+        return true;
+    }
+    fp n, t, s, inv2, x0sq, x0;
+    fp_sqr(n, a.c0);
+    fp_sqr(t, a.c1);
+    fp_add(n, n, t);
+    if (!fp_sqrt(s, n)) return false;
+    fp two;
+    fp_add(two, FP_ONE_MONT, FP_ONE_MONT);
+    fp_inv(inv2, two);
+    fp_add(x0sq, a.c0, s);
+    fp_mul(x0sq, x0sq, inv2);
+    if (!fp_sqrt(x0, x0sq)) {
+        fp_sub(x0sq, a.c0, s);
+        fp_mul(x0sq, x0sq, inv2);
+        if (!fp_sqrt(x0, x0sq)) return false;
+    }
+    fp denom, dinv;
+    fp_add(denom, x0, x0);
+    if (fp_is_zero(denom)) return false;
+    fp_inv(dinv, denom);
+    r.c0 = x0;
+    fp_mul(r.c1, a.c1, dinv);
+    fp2 chk;
+    fp2_sqr(chk, r);
+    return fp2_eq(chk, a);
+}
+
+static int fp2_sgn0(const fp2& a) {
+    u64 c[6];
+    fp_from_mont(c, a.c0);
+    int s0 = (int)(c[0] & 1);
+    bool z0 = true;
+    for (int i = 0; i < 6; ++i) if (c[i]) { z0 = false; break; }
+    fp_from_mont(c, a.c1);
+    int s1 = (int)(c[0] & 1);
+    return s0 | ((z0 ? 1 : 0) & s1);
+}
+
+// lexicographically-largest on (c1, c0) — refimpl._fp2_is_larger
+static bool fp2_is_high(const fp2& y) {
+    u64 y1[6], n1[6];
+    fp ny0f, ny1f;
+    fp_neg(ny0f, y.c0);
+    fp_neg(ny1f, y.c1);
+    fp_from_mont(y1, y.c1);
+    fp_from_mont(n1, ny1f);
+    int c = fp_cmp_raw(y1, n1, 6);
+    if (c != 0) return c > 0;
+    u64 y0[6], n0[6];
+    fp_from_mont(y0, y.c0);
+    fp_from_mont(n0, ny0f);
+    return fp_cmp_raw(y0, n0, 6) > 0;
+}
+
+static void fp2_pow_limbs(fp2& r, const fp2& base, const u64* e, int nl) {
+    int top = -1;
+    for (int i = nl - 1; i >= 0 && top < 0; --i)
+        if (e[i]) for (int b = 63; b >= 0; --b)
+            if ((e[i] >> b) & 1) { top = i * 64 + b; break; }
+    if (top < 0) { r = FP2_ONE_; return; }
+    fp2 acc = base;
+    for (int k = top - 1; k >= 0; --k) {
+        fp2_sqr(acc, acc);
+        if ((e[k / 64] >> (k % 64)) & 1) fp2_mul(acc, acc, base);
+    }
+    r = acc;
+}
+
+// ---------------------------------------------------------------------------
+// Fp6 = Fp2[v]/(v^3 - xi), Fp12 = Fp6[w]/(w^2 - v)   (refimpl tower)
+// ---------------------------------------------------------------------------
+
+struct fp6 { fp2 c0, c1, c2; };
+struct fp12 { fp6 c0, c1; };
+
+static fp6 FP6_ZERO_, FP6_ONE_;
+static fp12 FP12_ONE_;
+
+static inline void fp6_add(fp6& r, const fp6& a, const fp6& b) {
+    fp2_add(r.c0, a.c0, b.c0);
+    fp2_add(r.c1, a.c1, b.c1);
+    fp2_add(r.c2, a.c2, b.c2);
+}
+static inline void fp6_sub(fp6& r, const fp6& a, const fp6& b) {
+    fp2_sub(r.c0, a.c0, b.c0);
+    fp2_sub(r.c1, a.c1, b.c1);
+    fp2_sub(r.c2, a.c2, b.c2);
+}
+static inline void fp6_neg(fp6& r, const fp6& a) {
+    fp2_neg(r.c0, a.c0); fp2_neg(r.c1, a.c1); fp2_neg(r.c2, a.c2);
+}
+static inline bool fp6_eq(const fp6& a, const fp6& b) {
+    return fp2_eq(a.c0, b.c0) && fp2_eq(a.c1, b.c1) && fp2_eq(a.c2, b.c2);
+}
+
+static void fp6_mul(fp6& r, const fp6& a, const fp6& b) {
+    fp2 t00, t11, t22, m, s, acc;
+    fp2_mul(t00, a.c0, b.c0);
+    fp2_mul(t11, a.c1, b.c1);
+    fp2_mul(t22, a.c2, b.c2);
+    // c0 = t00 + xi*(a1 b2 + a2 b1)
+    fp2_mul(m, a.c1, b.c2);
+    fp2_mul(s, a.c2, b.c1);
+    fp2_add(m, m, s);
+    fp2_mul_xi(m, m);
+    fp2 r0; fp2_add(r0, t00, m);
+    // c1 = a0 b1 + a1 b0 + xi t22
+    fp2_mul(m, a.c0, b.c1);
+    fp2_mul(s, a.c1, b.c0);
+    fp2_add(acc, m, s);
+    fp2_mul_xi(m, t22);
+    fp2 r1; fp2_add(r1, acc, m);
+    // c2 = a0 b2 + a2 b0 + t11
+    fp2_mul(m, a.c0, b.c2);
+    fp2_mul(s, a.c2, b.c0);
+    fp2_add(acc, m, s);
+    fp2 r2; fp2_add(r2, acc, t11);
+    r.c0 = r0; r.c1 = r1; r.c2 = r2;
+}
+
+static inline void fp6_mul_by_v(fp6& r, const fp6& a) {
+    fp2 t;
+    fp2_mul_xi(t, a.c2);
+    fp2 a0 = a.c0, a1 = a.c1;
+    r.c0 = t; r.c1 = a0; r.c2 = a1;
+}
+
+// A * (s00, 0, 0)
+static inline void fp6_mul_by_c0(fp6& r, const fp6& a, const fp2& s00) {
+    fp2_mul(r.c0, a.c0, s00);
+    fp2_mul(r.c1, a.c1, s00);
+    fp2_mul(r.c2, a.c2, s00);
+}
+
+// A * (0, b, c)
+static void fp6_mul_by_c12(fp6& r, const fp6& a, const fp2& b, const fp2& c) {
+    fp2 t, s;
+    fp2_mul(t, a.c1, c);
+    fp2_mul(s, a.c2, b);
+    fp2_add(t, t, s);
+    fp2 r0; fp2_mul_xi(r0, t);
+    fp2_mul(t, a.c2, c);
+    fp2_mul_xi(t, t);
+    fp2_mul(s, a.c0, b);
+    fp2 r1; fp2_add(r1, t, s);
+    fp2_mul(t, a.c0, c);
+    fp2_mul(s, a.c1, b);
+    fp2 r2; fp2_add(r2, t, s);
+    r.c0 = r0; r.c1 = r1; r.c2 = r2;
+}
+
+static void fp6_inv(fp6& r, const fp6& a) {
+    fp2 t0, t1, t2, m, s, norm, ninv;
+    fp2_sqr(t0, a.c0);
+    fp2_mul(m, a.c1, a.c2);
+    fp2_mul_xi(m, m);
+    fp2_sub(t0, t0, m);                 // a0^2 - xi a1 a2
+    fp2_sqr(t1, a.c2);
+    fp2_mul_xi(t1, t1);
+    fp2_mul(m, a.c0, a.c1);
+    fp2_sub(t1, t1, m);                 // xi a2^2 - a0 a1
+    fp2_sqr(t2, a.c1);
+    fp2_mul(m, a.c0, a.c2);
+    fp2_sub(t2, t2, m);                 // a1^2 - a0 a2
+    fp2_mul(m, a.c2, t1);
+    fp2_mul(s, a.c1, t2);
+    fp2_add(m, m, s);
+    fp2_mul_xi(m, m);
+    fp2_mul(s, a.c0, t0);
+    fp2_add(norm, s, m);
+    fp2_inv(ninv, norm);
+    fp2_mul(r.c0, t0, ninv);
+    fp2_mul(r.c1, t1, ninv);
+    fp2_mul(r.c2, t2, ninv);
+}
+
+static inline void fp12_conj(fp12& r, const fp12& a) {
+    r.c0 = a.c0; fp6_neg(r.c1, a.c1);
+}
+static inline bool fp12_eq(const fp12& a, const fp12& b) {
+    return fp6_eq(a.c0, b.c0) && fp6_eq(a.c1, b.c1);
+}
+
+static void fp12_mul(fp12& r, const fp12& a, const fp12& b) {
+    fp6 t0, t1, sa, sb, m;
+    fp6_mul(t0, a.c0, b.c0);
+    fp6_mul(t1, a.c1, b.c1);
+    fp6_add(sa, a.c0, a.c1);
+    fp6_add(sb, b.c0, b.c1);
+    fp6_mul(m, sa, sb);
+    fp6_sub(m, m, t0);
+    fp6 r1; fp6_sub(r1, m, t1);
+    fp6 vt; fp6_mul_by_v(vt, t1);
+    fp6 r0; fp6_add(r0, t0, vt);
+    r.c0 = r0; r.c1 = r1;
+}
+
+static void fp12_sqr(fp12& r, const fp12& a) {
+    // complex squaring: c0 = (a0+a1)(a0+v a1) - t - v t, c1 = 2t, t = a0 a1
+    fp6 t, s0, va1, s1, m, vt;
+    fp6_mul(t, a.c0, a.c1);
+    fp6_add(s0, a.c0, a.c1);
+    fp6_mul_by_v(va1, a.c1);
+    fp6_add(s1, a.c0, va1);
+    fp6_mul(m, s0, s1);
+    fp6_sub(m, m, t);
+    fp6_mul_by_v(vt, t);
+    fp6_sub(m, m, vt);
+    r.c0 = m;
+    fp6_add(r.c1, t, t);
+}
+
+static void fp12_inv(fp12& r, const fp12& a) {
+    fp6 t0, t1, norm, ninv;
+    fp6_mul(t0, a.c0, a.c0);
+    fp6_mul(t1, a.c1, a.c1);
+    fp6 vt; fp6_mul_by_v(vt, t1);
+    fp6_sub(norm, t0, vt);
+    fp6_inv(ninv, norm);
+    fp6_mul(r.c0, a.c0, ninv);
+    fp6 na; fp6_neg(na, a.c1);
+    fp6_mul(r.c1, na, ninv);
+}
+
+// sparse mul by line (c00; 0; 0 | 0; c11; c12)
+static void fp12_mul_sparse(fp12& r, const fp12& f,
+                            const fp2& s00, const fp2& s11, const fp2& s12) {
+    fp6 t0, t1, sum, fs, m;
+    fp6_mul_by_c0(t0, f.c0, s00);
+    fp6_mul_by_c12(t1, f.c1, s11, s12);
+    fp6 vt; fp6_mul_by_v(vt, t1);
+    fp6 r0; fp6_add(r0, t0, vt);
+    sum.c0 = s00; sum.c1 = s11; sum.c2 = s12;
+    fp6_add(fs, f.c0, f.c1);
+    fp6_mul(m, fs, sum);
+    fp6_sub(m, m, t0);
+    fp6 r1; fp6_sub(r1, m, t1);
+    r.c0 = r0; r.c1 = r1;
+}
+
+// Frobenius: FR1[i] = xi^(i(p-1)/6) in Fp2; FR2[i] = xi^(i(p^2-1)/6) in Fp.
+static fp2 FR1[6];
+static fp FR2[6];
+static fp2 PSI_CX_, PSI_CY_;  // psi constants: inv(FR1[2]), inv(FR1[3])
+
+// a^(p): conjugate Fp2 coefficients, multiply basis v^j w^k by FR1[2j+k]
+static void fp12_frob1(fp12& r, const fp12& a) {
+    fp2 t;
+    fp2_conj(t, a.c0.c0); fp2_mul(r.c0.c0, t, FR1[0]);
+    fp2_conj(t, a.c0.c1); fp2_mul(r.c0.c1, t, FR1[2]);
+    fp2_conj(t, a.c0.c2); fp2_mul(r.c0.c2, t, FR1[4]);
+    fp2_conj(t, a.c1.c0); fp2_mul(r.c1.c0, t, FR1[1]);
+    fp2_conj(t, a.c1.c1); fp2_mul(r.c1.c1, t, FR1[3]);
+    fp2_conj(t, a.c1.c2); fp2_mul(r.c1.c2, t, FR1[5]);
+}
+
+// a^(p^2): multiply basis v^j w^k by the Fp scalar FR2[2j+k]
+static void fp12_frob2(fp12& r, const fp12& a) {
+    fp2_mul_fp(r.c0.c0, a.c0.c0, FR2[0]);
+    fp2_mul_fp(r.c0.c1, a.c0.c1, FR2[2]);
+    fp2_mul_fp(r.c0.c2, a.c0.c2, FR2[4]);
+    fp2_mul_fp(r.c1.c0, a.c1.c0, FR2[1]);
+    fp2_mul_fp(r.c1.c1, a.c1.c1, FR2[3]);
+    fp2_mul_fp(r.c1.c2, a.c1.c2, FR2[5]);
+}
+
+static void fp12_pow_limbs(fp12& r, const fp12& base, const u64* e, int nl) {
+    int top = -1;
+    for (int i = nl - 1; i >= 0 && top < 0; --i)
+        if (e[i]) for (int b = 63; b >= 0; --b)
+            if ((e[i] >> b) & 1) { top = i * 64 + b; break; }
+    if (top < 0) { r = FP12_ONE_; return; }
+    fp12 acc = base;
+    for (int k = top - 1; k >= 0; --k) {
+        fp12_sqr(acc, acc);
+        if ((e[k / 64] >> (k % 64)) & 1) fp12_mul(acc, acc, base);
+    }
+    r = acc;
+}
+
+// f^|x| (cyclotomic input; plain squarings keep it simple and safe)
+static void fp12_pow_x_abs(fp12& r, const fp12& f) {
+    u64 e[1] = {X_ABS};
+    fp12_pow_limbs(r, f, e, 1);
+}
+
+// Exact final exponentiation: easy part, then
+// hard = d*(x+p)*(x^2+p^2-1) + 1 with d = (x-1)^2/3 (checked vs refimpl).
+static void final_exponentiation(fp12& r, const fp12& f) {
+    fp12 t, inv, fr;
+    fp12_conj(t, f);
+    fp12_inv(inv, f);
+    fp12_mul(t, t, inv);          // f^(p^6-1)
+    fp12_frob2(fr, t);
+    fp12_mul(t, fr, t);           // ^(p^2+1): easy part done; cyclotomic now
+    fp12 g;
+    fp12_pow_limbs(g, t, D_EXP, 2);          // t^d
+    fp12 gx, gp;
+    fp12_pow_x_abs(gx, g);
+    fp12_conj(gx, gx);                       // g^x  (x negative)
+    fp12_frob1(gp, g);                       // g^p
+    fp12 g2_; fp12_mul(g2_, gx, gp);         // g^(x+p)
+    fp12 gxx, h;
+    fp12_pow_x_abs(gxx, g2_);
+    fp12_pow_x_abs(gxx, gxx);                // g2^(x^2)  (sign^2 = +)
+    fp12_frob2(h, g2_);
+    fp12_mul(gxx, gxx, h);                   // * g2^(p^2)
+    fp12_conj(h, g2_);                       // g2^(-1) (cyclotomic)
+    fp12_mul(gxx, gxx, h);                   // g2^(x^2+p^2-1)
+    fp12_mul(r, gxx, t);                     // * t  (the +1)
+}
+
+// ---------------------------------------------------------------------------
+// Curve points: homogeneous projective over Fp (G1) and Fp2 (G2).
+// Generic via templates; b coefficients set at init.
+// ---------------------------------------------------------------------------
+
+struct OpsFp {
+    typedef fp El;
+    static void add(El& r, const El& a, const El& b) { fp_add(r, a, b); }
+    static void sub(El& r, const El& a, const El& b) { fp_sub(r, a, b); }
+    static void mul(El& r, const El& a, const El& b) { fp_mul(r, a, b); }
+    static void sqr(El& r, const El& a) { fp_sqr(r, a); }
+    static void neg(El& r, const El& a) { fp_neg(r, a); }
+    static void inv(El& r, const El& a) { fp_inv(r, a); }
+    static bool is_zero(const El& a) { return fp_is_zero(a); }
+    static bool eq(const El& a, const El& b) { return fp_eq(a, b); }
+    static El zero() { return FP_ZERO; }
+    static El one() { return FP_ONE_MONT; }
+    static El curve_b;
+};
+struct OpsFp2 {
+    typedef fp2 El;
+    static void add(El& r, const El& a, const El& b) { fp2_add(r, a, b); }
+    static void sub(El& r, const El& a, const El& b) { fp2_sub(r, a, b); }
+    static void mul(El& r, const El& a, const El& b) { fp2_mul(r, a, b); }
+    static void sqr(El& r, const El& a) { fp2_sqr(r, a); }
+    static void neg(El& r, const El& a) { fp2_neg(r, a); }
+    static void inv(El& r, const El& a) { fp2_inv(r, a); }
+    static bool is_zero(const El& a) { return fp2_is_zero(a); }
+    static bool eq(const El& a, const El& b) { return fp2_eq(a, b); }
+    static El zero() { return FP2_ZERO_; }
+    static El one() { return FP2_ONE_; }
+    static El curve_b;
+};
+fp OpsFp::curve_b;
+fp2 OpsFp2::curve_b;
+
+template <class O> struct pt {
+    typename O::El X, Y, Z;
+    bool inf;
+};
+
+template <class O> static pt<O> pt_infinity() {
+    pt<O> p;
+    p.X = O::zero(); p.Y = O::one(); p.Z = O::zero(); p.inf = true;
+    return p;
+}
+
+template <class O>
+static pt<O> pt_from_affine(const typename O::El& x, const typename O::El& y) {
+    pt<O> p;
+    p.X = x; p.Y = y; p.Z = O::one(); p.inf = false;
+    return p;
+}
+
+template <class O>
+static void pt_to_affine(typename O::El& x, typename O::El& y, const pt<O>& p) {
+    typename O::El zi;
+    O::inv(zi, p.Z);
+    O::mul(x, p.X, zi);
+    O::mul(y, p.Y, zi);
+}
+
+// projective doubling, a = 0 curve
+template <class O> static void pt_dbl(pt<O>& r, const pt<O>& p) {
+    if (p.inf || O::is_zero(p.Y)) { r = pt_infinity<O>(); return; }
+    typedef typename O::El El;
+    El XX, W, S, B, H, t, t2, YY, SS;
+    O::sqr(XX, p.X);
+    O::add(W, XX, XX); O::add(W, W, XX);          // 3X^2
+    O::mul(S, p.Y, p.Z);                          // YZ
+    O::mul(B, p.X, p.Y); O::mul(B, B, S);         // XY*S
+    O::sqr(H, W);
+    O::add(t, B, B); O::add(t, t, t); O::add(t2, t, t);  // 8B
+    O::sub(H, H, t2);                             // W^2 - 8B
+    O::mul(r.X, H, S); O::add(r.X, r.X, r.X);     // 2HS
+    O::sqr(YY, p.Y);
+    O::sqr(SS, S);
+    O::sub(t, t, H);                              // 4B - H
+    O::mul(t, W, t);
+    O::mul(t2, YY, SS);
+    O::add(t2, t2, t2); O::add(t2, t2, t2); O::add(t2, t2, t2);  // 8 Y^2 S^2
+    O::sub(r.Y, t, t2);
+    El S3;
+    O::mul(S3, SS, S);
+    O::add(r.Z, S3, S3); O::add(r.Z, r.Z, r.Z); O::add(r.Z, r.Z, r.Z);  // 8S^3
+    r.inf = false;
+    if (O::is_zero(r.Z)) r = pt_infinity<O>();
+}
+
+// mixed addition: p (projective) + q (affine)
+template <class O>
+static void pt_add_affine(pt<O>& r, const pt<O>& p,
+                          const typename O::El& qx, const typename O::El& qy) {
+    typedef typename O::El El;
+    if (p.inf) { r = pt_from_affine<O>(qx, qy); return; }
+    El u, v, t;
+    O::mul(u, qy, p.Z); O::sub(u, u, p.Y);        // yQ Z - Y
+    O::mul(v, qx, p.Z); O::sub(v, v, p.X);        // xQ Z - X
+    if (O::is_zero(v)) {
+        if (O::is_zero(u)) { pt_dbl(r, p); return; }
+        r = pt_infinity<O>();
+        return;
+    }
+    El vv, vvv, R_, A, uu;
+    O::sqr(vv, v);
+    O::mul(vvv, vv, v);
+    O::mul(R_, vv, p.X);
+    O::sqr(uu, u);
+    O::mul(A, uu, p.Z);
+    O::sub(A, A, vvv);
+    O::add(t, R_, R_);
+    O::sub(A, A, t);                              // u^2 Z - v^3 - 2 v^2 X
+    O::mul(r.X, v, A);
+    O::sub(t, R_, A);
+    O::mul(t, u, t);
+    El t2;
+    O::mul(t2, vvv, p.Y);
+    O::sub(r.Y, t, t2);
+    O::mul(r.Z, vvv, p.Z);
+    r.inf = false;
+    if (O::is_zero(r.Z)) r = pt_infinity<O>();
+}
+
+template <class O> static void pt_add(pt<O>& r, const pt<O>& p, const pt<O>& q) {
+    if (q.inf) { r = p; return; }
+    if (p.inf) { r = q; return; }
+    typename O::El qx, qy;
+    pt_to_affine(qx, qy, q);   // simple + rare in hot paths (buckets use mixed)
+    pt_add_affine(r, p, qx, qy);
+}
+
+// scalar mult, MSB-first double-and-add over limb scalar
+template <class O>
+static void pt_mul_limbs(pt<O>& r, const pt<O>& p, const u64* e, int nl) {
+    pt<O> acc = pt_infinity<O>();
+    int top = -1;
+    for (int i = nl - 1; i >= 0 && top < 0; --i)
+        if (e[i]) for (int b = 63; b >= 0; --b)
+            if ((e[i] >> b) & 1) { top = i * 64 + b; break; }
+    if (top < 0 || p.inf) { r = acc; return; }
+    typename O::El px, py;
+    pt_to_affine(px, py, p);
+    acc = pt_from_affine<O>(px, py);
+    for (int k = top - 1; k >= 0; --k) {
+        pt_dbl(acc, acc);
+        if ((e[k / 64] >> (k % 64)) & 1) pt_add_affine(acc, acc, px, py);
+    }
+    r = acc;
+}
+
+template <class O> static bool pt_on_curve_affine(const typename O::El& x,
+                                                  const typename O::El& y) {
+    typename O::El lhs, rhs;
+    O::sqr(lhs, y);
+    O::sqr(rhs, x);
+    O::mul(rhs, rhs, x);
+    O::add(rhs, rhs, O::curve_b);
+    return O::eq(lhs, rhs);
+}
+
+typedef pt<OpsFp> g1pt;
+typedef pt<OpsFp2> g2pt;
+
+static fp G1_GX, G1_GY;    // generator affine (set in init)
+static fp2 G2_GX, G2_GY;
+
+// ---------------------------------------------------------------------------
+// Miller loop (optimal ate) and pairing products.
+// P in G1 affine (Fp), Q in G2 affine (Fp2).  Lines are scaled by Fp2
+// factors only (see header), so final-exp output matches refimpl exactly.
+// ---------------------------------------------------------------------------
+
+struct g1aff { fp x, y; bool inf; };
+struct g2aff { fp2 x, y; bool inf; };
+
+// doubling step: updates T, emits line coefficients evaluated at P
+static void dbl_step(g2pt& T, fp2& l00, fp2& l11, fp2& l12,
+                     const fp& px, const fp& py) {
+    fp2 XX, W, YY, S, SS, t;
+    fp2_sqr(XX, T.X);
+    fp2_add(W, XX, XX); fp2_add(W, W, XX);        // 3X^2
+    fp2_sqr(YY, T.Y);
+    fp2_mul(S, T.Y, T.Z);                         // YZ
+    fp2_sqr(SS, S);
+    // l11 = 3X^3 - 2Y^2 Z
+    fp2 X3, Y2Z;
+    fp2_mul(X3, XX, T.X);
+    fp2_add(t, X3, X3); fp2_add(X3, t, X3);       // 3X^3
+    fp2_mul(Y2Z, YY, T.Z);
+    fp2_add(Y2Z, Y2Z, Y2Z);                       // 2Y^2 Z
+    fp2_sub(l11, X3, Y2Z);
+    // l12 = -(3X^2 Z) * xP
+    fp2 WZ;
+    fp2_mul(WZ, W, T.Z);
+    fp2_mul_fp(WZ, WZ, px);
+    fp2_neg(l12, WZ);
+    // l00 = xi * (2 Y Z^2) * yP       (2YZ^2 = 2 S Z)
+    fp2 SZ;
+    fp2_mul(SZ, S, T.Z);
+    fp2_add(SZ, SZ, SZ);
+    fp2_mul_fp(SZ, SZ, py);
+    fp2_mul_xi(l00, SZ);
+    // point doubling (same as pt_dbl, reusing XX/W/S/YY/SS)
+    fp2 B, H, t8b, Ynew;
+    fp2_mul(B, T.X, T.Y); fp2_mul(B, B, S);
+    fp2_sqr(H, W);
+    fp2_add(t, B, B); fp2_add(t, t, t);           // 4B
+    fp2_add(t8b, t, t);                           // 8B
+    fp2_sub(H, H, t8b);
+    fp2_mul(T.X, H, S); fp2_add(T.X, T.X, T.X);
+    fp2_sub(t, t, H);                             // 4B - H
+    fp2_mul(Ynew, W, t);
+    fp2_mul(t, YY, SS);
+    fp2_add(t, t, t); fp2_add(t, t, t); fp2_add(t, t, t);
+    fp2_sub(T.Y, Ynew, t);
+    fp2 S3;
+    fp2_mul(S3, SS, S);
+    fp2_add(T.Z, S3, S3); fp2_add(T.Z, T.Z, T.Z); fp2_add(T.Z, T.Z, T.Z);
+}
+
+// addition step: T += Q, line through T and Q evaluated at P
+static void add_step(g2pt& T, fp2& l00, fp2& l11, fp2& l12,
+                     const g2aff& Q, const fp& px, const fp& py) {
+    fp2 theta, mu, t;
+    fp2_mul(theta, Q.y, T.Z); fp2_sub(theta, T.Y, theta);  // Y - yQ Z
+    fp2_mul(mu, Q.x, T.Z); fp2_sub(mu, T.X, mu);           // X - xQ Z
+    // l11 = theta xQ - mu yQ ; l12 = -theta xP ; l00 = xi mu yP
+    fp2 a, b;
+    fp2_mul(a, theta, Q.x);
+    fp2_mul(b, mu, Q.y);
+    fp2_sub(l11, a, b);
+    fp2_mul_fp(t, theta, px);
+    fp2_neg(l12, t);
+    fp2_mul_fp(t, mu, py);
+    fp2_mul_xi(l00, t);
+    // T += Q (mixed, u = -theta, v = -mu)
+    fp2 u, v;
+    fp2_neg(u, theta);
+    fp2_neg(v, mu);
+    fp2 vv, vvv, R_, A, uu, t2;
+    fp2_sqr(vv, v);
+    fp2_mul(vvv, vv, v);
+    fp2_mul(R_, vv, T.X);
+    fp2_sqr(uu, u);
+    fp2_mul(A, uu, T.Z);
+    fp2_sub(A, A, vvv);
+    fp2_add(t, R_, R_);
+    fp2_sub(A, A, t);
+    fp2_mul(T.X, v, A);
+    fp2_sub(t, R_, A);
+    fp2_mul(t, u, t);
+    fp2_mul(t2, vvv, T.Y);
+    fp2_sub(T.Y, t, t2);
+    fp2_mul(t, vvv, T.Z);
+    T.Z = t;
+}
+
+// f *= miller(P, Q); skips infinity inputs (contributes 1, as refimpl).
+static void miller_accumulate(fp12& f, const g1aff& P, const g2aff& Q) {
+    if (P.inf || Q.inf) return;
+    g2pt T = pt_from_affine<OpsFp2>(Q.x, Q.y);
+    fp2 l00, l11, l12;
+    bool first = true;
+    fp12 g = FP12_ONE_;
+    for (int k = 62; k >= 0; --k) {       // bits of |x| below the top bit
+        if (!first) fp12_sqr(g, g);
+        dbl_step(T, l00, l11, l12, P.x, P.y);
+        fp12_mul_sparse(g, g, l00, l11, l12);
+        if ((X_ABS >> k) & 1) {
+            add_step(T, l00, l11, l12, Q, P.x, P.y);
+            fp12_mul_sparse(g, g, l00, l11, l12);
+        }
+        first = false;
+    }
+    fp12_conj(g, g);                      // x < 0
+    fp12 nf;
+    fp12_mul(nf, f, g);
+    f = nf;
+}
+
+static void pairing_full(fp12& out, const g1aff& P, const g2aff& Q) {
+    fp12 f = FP12_ONE_;
+    miller_accumulate(f, P, Q);
+    final_exponentiation(out, f);
+}
+
+// ---------------------------------------------------------------------------
+// psi endomorphism + subgroup checks + cofactor clearing.
+// ---------------------------------------------------------------------------
+
+static void g2_psi_aff(g2aff& r, const g2aff& p) {
+    if (p.inf) { r = p; return; }
+    fp2 cx, cy;
+    fp2_conj(cx, p.x);
+    fp2_conj(cy, p.y);
+    fp2_mul(r.x, PSI_CX_, cx);
+    fp2_mul(r.y, PSI_CY_, cy);
+    r.inf = false;
+}
+
+static g2aff g2_to_aff(const g2pt& p) {
+    g2aff r;
+    if (p.inf) { r.inf = true; r.x = FP2_ZERO_; r.y = FP2_ZERO_; return r; }
+    pt_to_affine(r.x, r.y, p);
+    r.inf = false;
+    return r;
+}
+
+static g1aff g1_to_aff(const g1pt& p) {
+    g1aff r;
+    if (p.inf) { r.inf = true; r.x = FP_ZERO; r.y = FP_ZERO; return r; }
+    pt_to_affine(r.x, r.y, p);
+    r.inf = false;
+    return r;
+}
+
+static bool g1_in_subgroup(const g1pt& p) {
+    g1pt t;
+    pt_mul_limbs(t, p, R_L, 4);
+    return t.inf;
+}
+
+static bool g2_in_subgroup(const g2pt& p) {
+    g2pt t;
+    pt_mul_limbs(t, p, R_L, 4);
+    return t.inf;
+}
+
+// [x]P for the negative BLS parameter (refimpl._g2_mul_x): -[|x|]P
+static void g2_mul_x(g2pt& r, const g2pt& p) {
+    u64 e[1] = {X_ABS};
+    g2pt t;
+    pt_mul_limbs(t, p, e, 1);
+    if (!t.inf) fp2_neg(t.Y, t.Y);
+    r = t;
+}
+
+// Budroni–Pintore: h_eff P = [x^2-x-1]P + [x-1]psi(P) + psi(psi([2]P))
+static void g2_clear_cofactor(g2pt& r, const g2pt& p) {
+    g2pt xp, x2p, t, part1, part2, part3;
+    g2_mul_x(xp, p);
+    g2_mul_x(x2p, xp);
+    pt_add(t, xp, p);
+    if (!t.inf) fp2_neg(t.Y, t.Y);
+    pt_add(part1, x2p, t);                       // [x^2 - x - 1] P
+    g2aff pa = g2_to_aff(p), psip_a;
+    g2_psi_aff(psip_a, pa);
+    g2pt psip = psip_a.inf ? pt_infinity<OpsFp2>()
+                           : pt_from_affine<OpsFp2>(psip_a.x, psip_a.y);
+    g2pt xpsip, npsip;
+    g2_mul_x(xpsip, psip);
+    npsip = psip;
+    if (!npsip.inf) fp2_neg(npsip.Y, npsip.Y);
+    pt_add(part2, xpsip, npsip);                 // [x-1] psi(P)
+    g2pt dp;
+    pt_dbl(dp, p);
+    g2aff dpa = g2_to_aff(dp), ps1, ps2;
+    g2_psi_aff(ps1, dpa);
+    g2_psi_aff(ps2, ps1);
+    part3 = ps2.inf ? pt_infinity<OpsFp2>()
+                    : pt_from_affine<OpsFp2>(ps2.x, ps2.y);
+    pt_add(t, part1, part2);
+    pt_add(r, t, part3);
+}
+
+static void g1_clear_cofactor(g1pt& r, const g1pt& p) {
+    pt_mul_limbs(r, p, D_EXP, 2);                // H1 = (x-1)^2/3
+}
+
+// ---------------------------------------------------------------------------
+// SHA-256 (compact, for expand_message_xmd)
+// ---------------------------------------------------------------------------
+
+struct sha256_ctx { uint32_t h[8]; uint8_t buf[64]; u64 len; size_t fill; };
+
+static const uint32_t SHA_K[64] = {
+    0x428a2f98,0x71374491,0xb5c0fbcf,0xe9b5dba5,0x3956c25b,0x59f111f1,
+    0x923f82a4,0xab1c5ed5,0xd807aa98,0x12835b01,0x243185be,0x550c7dc3,
+    0x72be5d74,0x80deb1fe,0x9bdc06a7,0xc19bf174,0xe49b69c1,0xefbe4786,
+    0x0fc19dc6,0x240ca1cc,0x2de92c6f,0x4a7484aa,0x5cb0a9dc,0x76f988da,
+    0x983e5152,0xa831c66d,0xb00327c8,0xbf597fc7,0xc6e00bf3,0xd5a79147,
+    0x06ca6351,0x14292967,0x27b70a85,0x2e1b2138,0x4d2c6dfc,0x53380d13,
+    0x650a7354,0x766a0abb,0x81c2c92e,0x92722c85,0xa2bfe8a1,0xa81a664b,
+    0xc24b8b70,0xc76c51a3,0xd192e819,0xd6990624,0xf40e3585,0x106aa070,
+    0x19a4c116,0x1e376c08,0x2748774c,0x34b0bcb5,0x391c0cb3,0x4ed8aa4a,
+    0x5b9cca4f,0x682e6ff3,0x748f82ee,0x78a5636f,0x84c87814,0x8cc70208,
+    0x90befffa,0xa4506ceb,0xbef9a3f7,0xc67178f2,
+};
+
+static inline uint32_t rotr32(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+}
+
+static void sha256_init(sha256_ctx& c) {
+    static const uint32_t H0[8] = {
+        0x6a09e667,0xbb67ae85,0x3c6ef372,0xa54ff53a,
+        0x510e527f,0x9b05688c,0x1f83d9ab,0x5be0cd19,
+    };
+    memcpy(c.h, H0, sizeof H0);
+    c.len = 0; c.fill = 0;
+}
+
+static void sha256_block(sha256_ctx& c, const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+        w[i] = ((uint32_t)p[4*i] << 24) | ((uint32_t)p[4*i+1] << 16) |
+               ((uint32_t)p[4*i+2] << 8) | p[4*i+3];
+    for (int i = 16; i < 64; ++i) {
+        uint32_t s0 = rotr32(w[i-15],7) ^ rotr32(w[i-15],18) ^ (w[i-15] >> 3);
+        uint32_t s1 = rotr32(w[i-2],17) ^ rotr32(w[i-2],19) ^ (w[i-2] >> 10);
+        w[i] = w[i-16] + s0 + w[i-7] + s1;
+    }
+    uint32_t a=c.h[0],b=c.h[1],cc=c.h[2],d=c.h[3],
+             e=c.h[4],f=c.h[5],g=c.h[6],h=c.h[7];
+    for (int i = 0; i < 64; ++i) {
+        uint32_t S1 = rotr32(e,6) ^ rotr32(e,11) ^ rotr32(e,25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = h + S1 + ch + SHA_K[i] + w[i];
+        uint32_t S0 = rotr32(a,2) ^ rotr32(a,13) ^ rotr32(a,22);
+        uint32_t mj = (a & b) ^ (a & cc) ^ (b & cc);
+        uint32_t t2 = S0 + mj;
+        h=g; g=f; f=e; e=d+t1; d=cc; cc=b; b=a; a=t1+t2;
+    }
+    c.h[0]+=a; c.h[1]+=b; c.h[2]+=cc; c.h[3]+=d;
+    c.h[4]+=e; c.h[5]+=f; c.h[6]+=g; c.h[7]+=h;
+}
+
+static void sha256_update(sha256_ctx& c, const uint8_t* p, size_t n) {
+    c.len += n;
+    while (n) {
+        size_t take = 64 - c.fill;
+        if (take > n) take = n;
+        memcpy(c.buf + c.fill, p, take);
+        c.fill += take; p += take; n -= take;
+        if (c.fill == 64) { sha256_block(c, c.buf); c.fill = 0; }
+    }
+}
+
+static void sha256_final(sha256_ctx& c, uint8_t out[32]) {
+    u64 bits = c.len * 8;
+    uint8_t pad = 0x80;
+    sha256_update(c, &pad, 1);
+    uint8_t z = 0;
+    while (c.fill != 56) sha256_update(c, &z, 1);
+    uint8_t lb[8];
+    for (int i = 0; i < 8; ++i) lb[i] = (uint8_t)(bits >> (8 * (7 - i)));
+    sha256_update(c, lb, 8);
+    for (int i = 0; i < 8; ++i)
+        for (int j = 0; j < 4; ++j)
+            out[4*i + j] = (uint8_t)(c.h[i] >> (8 * (3 - j)));
+}
+
+// ---------------------------------------------------------------------------
+// expand_message_xmd + hash_to_field (RFC 9380 §5, SHA-256), DSTs pinned to
+// refimpl.DST_G1/DST_G2.
+// ---------------------------------------------------------------------------
+
+static const char DST_G2_S[] = "DRANDTPU-V01-CS01-BLS12381G2_XMD:SHA-256_SVDW_RO_";
+static const char DST_G1_S[] = "DRANDTPU-V01-CS01-BLS12381G1_XMD:SHA-256_SVDW_RO_";
+
+static void expand_message_xmd(uint8_t* out, size_t len_in_bytes,
+                               const uint8_t* msg, size_t msg_len,
+                               const uint8_t* dst, size_t dst_len) {
+    const size_t b_in_bytes = 32, s_in_bytes = 64;
+    size_t ell = (len_in_bytes + b_in_bytes - 1) / b_in_bytes;
+    uint8_t dst_prime[256];
+    memcpy(dst_prime, dst, dst_len);
+    dst_prime[dst_len] = (uint8_t)dst_len;
+    size_t dpl = dst_len + 1;
+    uint8_t zpad[s_in_bytes];
+    memset(zpad, 0, sizeof zpad);
+    uint8_t lib[2] = {(uint8_t)(len_in_bytes >> 8), (uint8_t)len_in_bytes};
+    uint8_t zero = 0;
+    sha256_ctx c;
+    uint8_t b0[32], bi[32];
+    sha256_init(c);
+    sha256_update(c, zpad, s_in_bytes);
+    sha256_update(c, msg, msg_len);
+    sha256_update(c, lib, 2);
+    sha256_update(c, &zero, 1);
+    sha256_update(c, dst_prime, dpl);
+    sha256_final(c, b0);
+    uint8_t ctr = 1;
+    sha256_init(c);
+    sha256_update(c, b0, 32);
+    sha256_update(c, &ctr, 1);
+    sha256_update(c, dst_prime, dpl);
+    sha256_final(c, bi);
+    size_t off = 0;
+    for (size_t i = 1; ; ++i) {
+        size_t take = len_in_bytes - off;
+        if (take > 32) take = 32;
+        memcpy(out + off, bi, take);
+        off += take;
+        if (off >= len_in_bytes || i >= ell) break;
+        uint8_t x[32];
+        for (int j = 0; j < 32; ++j) x[j] = b0[j] ^ bi[j];
+        ctr = (uint8_t)(i + 1);
+        sha256_init(c);
+        sha256_update(c, x, 32);
+        sha256_update(c, &ctr, 1);
+        sha256_update(c, dst_prime, dpl);
+        sha256_final(c, bi);
+    }
+}
+
+// reduce 64 big-endian bytes mod p, to Montgomery form
+static void fp_from_wide_be(fp& r, const uint8_t in[64]) {
+    // value = hi(16 bytes) * 2^384 + lo(48 bytes)
+    u64 lo[6] = {0}, hi[6] = {0};
+    for (int i = 0; i < 6; ++i)
+        for (int j = 0; j < 8; ++j)
+            lo[i] |= (u64)in[64 - 8 * (i + 1) + (7 - j)] << (8 * j);
+    for (int i = 0; i < 2; ++i)
+        for (int j = 0; j < 8; ++j)
+            hi[i] |= (u64)in[16 - 8 * (i + 1) + (7 - j)] << (8 * j);
+    while (fp_cmp_raw(lo, P_L, 6) >= 0) sub_limbs(lo, lo, P_L, 6);
+    fp lo_f, hi_f, hi_mont, hi_shift;
+    memcpy(lo_f.l, lo, sizeof lo);
+    memcpy(hi_f.l, hi, sizeof hi);
+    fp_mul(lo_f, lo_f, R2);        // to_mont(lo) = lo·R
+    fp_mul(hi_mont, hi_f, R2);     // to_mont(hi) = hi·R
+    fp_mul(hi_shift, hi_mont, R2); // (hi·R)·R²/R = hi·R² = to_mont(hi·2^384)
+    fp_add(r, lo_f, hi_shift);
+}
+
+static void hash_to_field_fp2_2(fp2 u[2], const uint8_t* msg, size_t len) {
+    uint8_t buf[4 * 64];
+    expand_message_xmd(buf, sizeof buf, msg, len,
+                       (const uint8_t*)DST_G2_S, sizeof(DST_G2_S) - 1);
+    for (int i = 0; i < 2; ++i) {
+        fp_from_wide_be(u[i].c0, buf + i * 128);
+        fp_from_wide_be(u[i].c1, buf + i * 128 + 64);
+    }
+}
+
+static void hash_to_field_fp_2(fp u[2], const uint8_t* msg, size_t len) {
+    uint8_t buf[2 * 64];
+    expand_message_xmd(buf, sizeof buf, msg, len,
+                       (const uint8_t*)DST_G1_S, sizeof(DST_G1_S) - 1);
+    fp_from_wide_be(u[0], buf);
+    fp_from_wide_be(u[1], buf + 64);
+}
+
+// ---------------------------------------------------------------------------
+// SVDW map (RFC 9380 §6.6.1), constants derived at init from the pinned Z
+// (Z_G1 = -3, Z_G2 = u — the values refimpl's small-magnitude search finds;
+// init asserts the SVDW preconditions, tests pin byte equality).
+// ---------------------------------------------------------------------------
+
+template <class O> struct svdw {
+    typename O::El Z, c1, c2, c3, c4;
+};
+
+static svdw<OpsFp> SVDW1;
+static svdw<OpsFp2> SVDW2;
+
+template <class O>
+static bool svdw_init(svdw<O>& s, const typename O::El& z,
+                      bool (*is_square)(const typename O::El&),
+                      bool (*sqrt_fn)(typename O::El&, const typename O::El&),
+                      int (*sgn0_fn)(const typename O::El&)) {
+    typedef typename O::El El;
+    s.Z = z;
+    El zz, gz, t, h;
+    O::sqr(zz, z);
+    O::mul(gz, zz, z);
+    O::add(gz, gz, O::curve_b);               // g(Z)
+    if (O::is_zero(gz)) return false;
+    s.c1 = gz;
+    El two, inv2;
+    O::add(two, O::one(), O::one());
+    O::inv(inv2, two);
+    O::mul(t, z, inv2);
+    O::neg(s.c2, t);                          // -Z/2
+    O::add(h, zz, zz); O::add(h, h, zz);      // 3Z^2
+    if (O::is_zero(h)) return false;
+    El gh, c3;
+    O::mul(gh, gz, h);
+    O::neg(gh, gh);
+    if (!sqrt_fn(c3, gh)) return false;       // sqrt(-g(Z)·3Z^2)
+    if (sgn0_fn(c3) == 1) O::neg(c3, c3);
+    s.c3 = c3;
+    El num, hinv;
+    O::add(num, gz, gz); O::add(num, num, num);  // 4 g(Z)
+    O::neg(num, num);
+    O::inv(hinv, h);
+    O::mul(s.c4, num, hinv);                  // -4 g(Z) / (3Z^2)
+    return true;
+}
+
+template <class O>
+static void svdw_map(typename O::El& x, typename O::El& y, const svdw<O>& s,
+                     const typename O::El& u,
+                     bool (*is_square)(const typename O::El&),
+                     bool (*sqrt_fn)(typename O::El&, const typename O::El&),
+                     int (*sgn0_fn)(const typename O::El&)) {
+    typedef typename O::El El;
+    El tv1, tv2, tv3, tv4, x1, x2, x3, gx, t;
+    O::sqr(tv1, u);
+    O::mul(tv1, tv1, s.c1);                   // u^2 g(Z)
+    O::add(tv2, O::one(), tv1);               // 1 + tv1
+    O::sub(tv1, O::one(), tv1);               // 1 - tv1
+    O::mul(tv3, tv1, tv2);
+    if (O::is_zero(tv3)) tv3 = O::zero(); else O::inv(tv3, tv3);
+    O::mul(tv4, u, tv1);
+    O::mul(tv4, tv4, tv3);
+    O::mul(tv4, tv4, s.c3);
+    O::sub(x1, s.c2, tv4);
+    O::add(x2, s.c2, tv4);
+    O::sqr(t, tv2);
+    O::mul(t, t, tv3);
+    O::sqr(t, t);
+    O::mul(t, t, s.c4);
+    O::add(x3, t, s.Z);
+    // pick first x with square g(x)
+    O::sqr(gx, x1); O::mul(gx, gx, x1); O::add(gx, gx, O::curve_b);
+    if (is_square(gx)) { x = x1; }
+    else {
+        O::sqr(gx, x2); O::mul(gx, gx, x2); O::add(gx, gx, O::curve_b);
+        if (is_square(gx)) { x = x2; }
+        else { x = x3; O::sqr(gx, x3); O::mul(gx, gx, x3); O::add(gx, gx, O::curve_b); }
+    }
+    bool ok = sqrt_fn(y, gx);
+    (void)ok;  // guaranteed square by construction
+    if (sgn0_fn(u) != sgn0_fn(y)) O::neg(y, y);
+}
+
+static void hash_to_g2_point(g2pt& out, const uint8_t* msg, size_t len) {
+    fp2 u[2], x0, y0, x1, y1;
+    hash_to_field_fp2_2(u, msg, len);
+    svdw_map<OpsFp2>(x0, y0, SVDW2, u[0], fp2_is_square, fp2_sqrt, fp2_sgn0);
+    svdw_map<OpsFp2>(x1, y1, SVDW2, u[1], fp2_is_square, fp2_sqrt, fp2_sgn0);
+    g2pt q0 = pt_from_affine<OpsFp2>(x0, y0);
+    pt_add_affine(q0, q0, x1, y1);
+    g2_clear_cofactor(out, q0);
+}
+
+static void hash_to_g1_point(g1pt& out, const uint8_t* msg, size_t len) {
+    fp u[2], x0, y0, x1, y1;
+    hash_to_field_fp_2(u, msg, len);
+    svdw_map<OpsFp>(x0, y0, SVDW1, u[0], fp_is_square, fp_sqrt, fp_sgn0);
+    svdw_map<OpsFp>(x1, y1, SVDW1, u[1], fp_is_square, fp_sqrt, fp_sgn0);
+    g1pt q0 = pt_from_affine<OpsFp>(x0, y0);
+    pt_add_affine(q0, q0, x1, y1);
+    g1_clear_cofactor(out, q0);
+}
+
+// ---------------------------------------------------------------------------
+// Serialization (48/96-byte compressed, flags in top 3 bits — refimpl format)
+// ---------------------------------------------------------------------------
+
+static const uint8_t FLAG_COMPRESSED = 0x80;
+static const uint8_t FLAG_INFINITY = 0x40;
+static const uint8_t FLAG_SIGN = 0x20;
+
+static void g1_serialize(uint8_t out[48], const g1aff& p) {
+    if (p.inf) {
+        memset(out, 0, 48);
+        out[0] = FLAG_COMPRESSED | FLAG_INFINITY;
+        return;
+    }
+    fp_to_bytes(out, p.x);
+    out[0] |= FLAG_COMPRESSED;
+    if (fp_is_high(p.y)) out[0] |= FLAG_SIGN;
+}
+
+static int g1_deserialize(g1aff& p, const uint8_t in[48], int subgroup_check) {
+    uint8_t flags = in[0];
+    if (!(flags & FLAG_COMPRESSED)) return -1;
+    if (flags & FLAG_INFINITY) {
+        if (flags & ~(FLAG_COMPRESSED | FLAG_INFINITY)) return -1;
+        for (int i = 1; i < 48; ++i) if (in[i]) return -1;
+        if (in[0] != (FLAG_COMPRESSED | FLAG_INFINITY)) return -1;
+        p.inf = true; p.x = FP_ZERO; p.y = FP_ZERO;
+        return 0;
+    }
+    uint8_t buf[48];
+    memcpy(buf, in, 48);
+    buf[0] &= 0x1F;
+    fp x;
+    if (fp_from_bytes(x, buf) != 0) return -1;
+    fp rhs, y;
+    fp_sqr(rhs, x);
+    fp_mul(rhs, rhs, x);
+    fp_add(rhs, rhs, OpsFp::curve_b);
+    if (!fp_sqrt(y, rhs)) return -2;
+    bool want_high = (flags & FLAG_SIGN) != 0;
+    if (fp_is_high(y) != want_high) fp_neg(y, y);
+    p.x = x; p.y = y; p.inf = false;
+    if (subgroup_check) {
+        g1pt pp = pt_from_affine<OpsFp>(x, y);
+        if (!g1_in_subgroup(pp)) return -3;
+    }
+    return 0;
+}
+
+static void g2_serialize(uint8_t out[96], const g2aff& p) {
+    if (p.inf) {
+        memset(out, 0, 96);
+        out[0] = FLAG_COMPRESSED | FLAG_INFINITY;
+        return;
+    }
+    fp_to_bytes(out, p.x.c1);        // x1 first (refimpl order)
+    fp_to_bytes(out + 48, p.x.c0);
+    out[0] |= FLAG_COMPRESSED;
+    if (fp2_is_high(p.y)) out[0] |= FLAG_SIGN;
+}
+
+static int g2_deserialize(g2aff& p, const uint8_t in[96], int subgroup_check) {
+    uint8_t flags = in[0];
+    if (!(flags & FLAG_COMPRESSED)) return -1;
+    if (flags & FLAG_INFINITY) {
+        if (flags & ~(FLAG_COMPRESSED | FLAG_INFINITY)) return -1;
+        for (int i = 1; i < 96; ++i) if (in[i]) return -1;
+        if (in[0] != (FLAG_COMPRESSED | FLAG_INFINITY)) return -1;
+        p.inf = true; p.x = FP2_ZERO_; p.y = FP2_ZERO_;
+        return 0;
+    }
+    uint8_t buf[48];
+    memcpy(buf, in, 48);
+    buf[0] &= 0x1F;
+    fp2 x;
+    if (fp_from_bytes(x.c1, buf) != 0) return -1;
+    if (fp_from_bytes(x.c0, in + 48) != 0) return -1;
+    fp2 rhs, y;
+    fp2_sqr(rhs, x);
+    fp2_mul(rhs, rhs, x);
+    fp2_add(rhs, rhs, OpsFp2::curve_b);
+    if (!fp2_sqrt(y, rhs)) return -2;
+    bool want_high = (flags & FLAG_SIGN) != 0;
+    if (fp2_is_high(y) != want_high) fp2_neg(y, y);
+    p.x = x; p.y = y; p.inf = false;
+    if (subgroup_check) {
+        g2pt pp = pt_from_affine<OpsFp2>(x, y);
+        if (!g2_in_subgroup(pp)) return -3;
+    }
+    return 0;
+}
+
+// scalar: 32 big-endian bytes -> 4x64 LE limbs, reduced mod r
+static void scalar_from_bytes(u64 out[4], const uint8_t in[32]) {
+    for (int i = 0; i < 4; ++i) {
+        out[i] = 0;
+        for (int j = 0; j < 8; ++j)
+            out[i] |= (u64)in[32 - 8 * (i + 1) + (7 - j)] << (8 * j);
+    }
+    while (fp_cmp_raw(out, R_L, 4) >= 0) sub_limbs(out, out, R_L, 4);
+}
+
+// ---------------------------------------------------------------------------
+// Pippenger MSM (window 4) over either group.
+// ---------------------------------------------------------------------------
+
+template <class O>
+static void msm_run(pt<O>& result, const typename O::El* xs,
+                    const typename O::El* ys, const bool* infs,
+                    const u64 (*scalars)[4], size_t n) {
+    const int W = 4, NWIN = 256 / W;
+    pt<O> acc = pt_infinity<O>();
+    for (int w = NWIN - 1; w >= 0; --w) {
+        if (!acc.inf)
+            for (int k = 0; k < W; ++k) pt_dbl(acc, acc);
+        pt<O> buckets[15];
+        for (int b = 0; b < 15; ++b) buckets[b] = pt_infinity<O>();
+        int bit = w * W;
+        for (size_t i = 0; i < n; ++i) {
+            if (infs[i]) continue;
+            int limb = bit / 64, off = bit % 64;
+            u64 d = (scalars[i][limb] >> off) & 0xF;
+            if (d) pt_add_affine(buckets[d - 1], buckets[d - 1], xs[i], ys[i]);
+        }
+        pt<O> running = pt_infinity<O>(), sum = pt_infinity<O>();
+        for (int b = 14; b >= 0; --b) {
+            pt_add(running, running, buckets[b]);
+            pt_add(sum, sum, running);
+        }
+        pt_add(acc, acc, sum);
+    }
+    result = acc;
+}
+
+// ---------------------------------------------------------------------------
+// init: derive all constants; returns 0 on success.
+// ---------------------------------------------------------------------------
+
+static bool INIT_DONE = false;
+static int INIT_STATUS = -100;
+
+static int do_init() {
+    memset(&FP_ZERO, 0, sizeof FP_ZERO);
+    fp_from_u64(FP_ONE_MONT, 1);
+    FP2_ZERO_.c0 = FP_ZERO; FP2_ZERO_.c1 = FP_ZERO;
+    FP2_ONE_.c0 = FP_ONE_MONT; FP2_ONE_.c1 = FP_ZERO;
+    XI_.c0 = FP_ONE_MONT; XI_.c1 = FP_ONE_MONT;
+    FP6_ZERO_.c0 = FP2_ZERO_; FP6_ZERO_.c1 = FP2_ZERO_; FP6_ZERO_.c2 = FP2_ZERO_;
+    FP6_ONE_.c0 = FP2_ONE_; FP6_ONE_.c1 = FP2_ZERO_; FP6_ONE_.c2 = FP2_ZERO_;
+    FP12_ONE_.c0 = FP6_ONE_; FP12_ONE_.c1 = FP6_ZERO_;
+    // exponents from p
+    u64 two[6] = {2, 0, 0, 0, 0, 0}, one[6] = {1, 0, 0, 0, 0, 0};
+    sub_limbs(EXP_P_MINUS_2, P_L, two, 6);
+    memcpy(EXP_QR, P_L, sizeof EXP_QR);
+    sub_limbs(EXP_QR, EXP_QR, one, 6);
+    shr_limbs(EXP_QR, 6, 1);                      // (p-1)/2
+    memcpy(HALF_P, EXP_QR, sizeof HALF_P);
+    memcpy(EXP_SQRT, P_L, sizeof EXP_SQRT);
+    add_limbs(EXP_SQRT, EXP_SQRT, one, 6);
+    shr_limbs(EXP_SQRT, 6, 2);                    // (p+1)/4
+    memcpy(EXP_P16, P_L, sizeof EXP_P16);
+    sub_limbs(EXP_P16, EXP_P16, one, 6);
+    div_small(EXP_P16, 6, 6);                     // (p-1)/6
+    // d = (x-1)^2 / 3 = (|x|+1)^2 / 3 (126-bit)
+    u128 xm1 = (u128)X_ABS + 1;                   // |x - 1|
+    u128 d = 0;
+    {
+        // (|x|+1)^2 = hi*2^64 + lo pieces via u128 school mult
+        u64 a = (u64)(xm1 >> 64), b = (u64)xm1;   // a = 0 here but keep general
+        (void)a;
+        u128 lo = (u128)b * b;                    // fits: b < 2^64
+        d = lo / 3;                               // (x-1)^2 < 2^128, exact /3
+        // note: for BLS12-381, (|x|+1) < 2^64 so lo is the whole square;
+        // exactness checked below
+        if (lo % 3 != 0) return -90;
+    }
+    D_EXP[0] = (u64)d;
+    D_EXP[1] = (u64)(d >> 64);
+    // curve b constants
+    fp four;
+    fp_from_u64(four, 4);
+    OpsFp::curve_b = four;
+    OpsFp2::curve_b.c0 = four;
+    OpsFp2::curve_b.c1 = four;                    // 4(1+u)
+    // Frobenius constants: FR1[1] = xi^((p-1)/6); FR1[i] = FR1[1]^i
+    fp2 base;
+    fp2_pow_limbs(base, XI_, EXP_P16, 6);
+    FR1[0] = FP2_ONE_;
+    for (int i = 1; i < 6; ++i) fp2_mul(FR1[i], FR1[i - 1], base);
+    // FR2[1] = norm(FR1[1]) in Fp; FR2[i] = FR2[1]^i
+    fp2 cj, n;
+    fp2_conj(cj, base);
+    fp2_mul(n, base, cj);
+    if (!fp_is_zero(n.c1)) return -91;
+    FR2[0] = FP_ONE_MONT;
+    for (int i = 1; i < 6; ++i) fp_mul(FR2[i], FR2[i - 1], n.c0);
+    // psi constants
+    fp2_inv(PSI_CX_, FR1[2]);
+    fp2_inv(PSI_CY_, FR1[3]);
+    // generators (canonical constants, checked on curve + subgroup below)
+    static const uint8_t G1X[48] = {
+        0x17,0xf1,0xd3,0xa7,0x31,0x97,0xd7,0x94,0x26,0x95,0x63,0x8c,
+        0x4f,0xa9,0xac,0x0f,0xc3,0x68,0x8c,0x4f,0x97,0x74,0xb9,0x05,
+        0xa1,0x4e,0x3a,0x3f,0x17,0x1b,0xac,0x58,0x6c,0x55,0xe8,0x3f,
+        0xf9,0x7a,0x1a,0xef,0xfb,0x3a,0xf0,0x0a,0xdb,0x22,0xc6,0xbb};
+    static const uint8_t G1Y[48] = {
+        0x08,0xb3,0xf4,0x81,0xe3,0xaa,0xa0,0xf1,0xa0,0x9e,0x30,0xed,
+        0x74,0x1d,0x8a,0xe4,0xfc,0xf5,0xe0,0x95,0xd5,0xd0,0x0a,0xf6,
+        0x00,0xdb,0x18,0xcb,0x2c,0x04,0xb3,0xed,0xd0,0x3c,0xc7,0x44,
+        0xa2,0x88,0x8a,0xe4,0x0c,0xaa,0x23,0x29,0x46,0xc5,0xe7,0xe1};
+    static const uint8_t G2X0[48] = {
+        0x02,0x4a,0xa2,0xb2,0xf0,0x8f,0x0a,0x91,0x26,0x08,0x05,0x27,
+        0x2d,0xc5,0x10,0x51,0xc6,0xe4,0x7a,0xd4,0xfa,0x40,0x3b,0x02,
+        0xb4,0x51,0x0b,0x64,0x7a,0xe3,0xd1,0x77,0x0b,0xac,0x03,0x26,
+        0xa8,0x05,0xbb,0xef,0xd4,0x80,0x56,0xc8,0xc1,0x21,0xbd,0xb8};
+    static const uint8_t G2X1[48] = {
+        0x13,0xe0,0x2b,0x60,0x52,0x71,0x9f,0x60,0x7d,0xac,0xd3,0xa0,
+        0x88,0x27,0x4f,0x65,0x59,0x6b,0xd0,0xd0,0x99,0x20,0xb6,0x1a,
+        0xb5,0xda,0x61,0xbb,0xdc,0x7f,0x50,0x49,0x33,0x4c,0xf1,0x12,
+        0x13,0x94,0x5d,0x57,0xe5,0xac,0x7d,0x05,0x5d,0x04,0x2b,0x7e};
+    static const uint8_t G2Y0[48] = {
+        0x0c,0xe5,0xd5,0x27,0x72,0x7d,0x6e,0x11,0x8c,0xc9,0xcd,0xc6,
+        0xda,0x2e,0x35,0x1a,0xad,0xfd,0x9b,0xaa,0x8c,0xbd,0xd3,0xa7,
+        0x6d,0x42,0x9a,0x69,0x51,0x60,0xd1,0x2c,0x92,0x3a,0xc9,0xcc,
+        0x3b,0xac,0xa2,0x89,0xe1,0x93,0x54,0x86,0x08,0xb8,0x28,0x01};
+    static const uint8_t G2Y1[48] = {
+        0x06,0x06,0xc4,0xa0,0x2e,0xa7,0x34,0xcc,0x32,0xac,0xd2,0xb0,
+        0x2b,0xc2,0x8b,0x99,0xcb,0x3e,0x28,0x7e,0x85,0xa7,0x63,0xaf,
+        0x26,0x74,0x92,0xab,0x57,0x2e,0x99,0xab,0x3f,0x37,0x0d,0x27,
+        0x5c,0xec,0x1d,0xa1,0xaa,0xa9,0x07,0x5f,0xf0,0x5f,0x79,0xbe};
+    if (fp_from_bytes(G1_GX, G1X) || fp_from_bytes(G1_GY, G1Y)) return -92;
+    if (fp_from_bytes(G2_GX.c0, G2X0) || fp_from_bytes(G2_GX.c1, G2X1) ||
+        fp_from_bytes(G2_GY.c0, G2Y0) || fp_from_bytes(G2_GY.c1, G2Y1))
+        return -92;
+    if (!pt_on_curve_affine<OpsFp>(G1_GX, G1_GY)) return -93;
+    if (!pt_on_curve_affine<OpsFp2>(G2_GX, G2_GY)) return -94;
+    {
+        g1pt g = pt_from_affine<OpsFp>(G1_GX, G1_GY);
+        if (!g1_in_subgroup(g)) return -95;
+        g2pt h = pt_from_affine<OpsFp2>(G2_GX, G2_GY);
+        if (!g2_in_subgroup(h)) return -96;
+    }
+    // SVDW: Z_G1 = -3, Z_G2 = u (what refimpl's search finds; asserted here)
+    fp three, zg1;
+    fp_from_u64(three, 3);
+    fp_neg(zg1, three);
+    if (!svdw_init<OpsFp>(SVDW1, zg1, fp_is_square, fp_sqrt, fp_sgn0))
+        return -97;
+    fp2 zg2;
+    zg2.c0 = FP_ZERO; zg2.c1 = FP_ONE_MONT;
+    if (!svdw_init<OpsFp2>(SVDW2, zg2, fp2_is_square, fp2_sqrt, fp2_sgn0))
+        return -98;
+    return 0;
+}
+
+static int ensure_init() {
+    if (!INIT_DONE) {
+        INIT_STATUS = do_init();
+        INIT_DONE = true;
+    }
+    return INIT_STATUS;
+}
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// 0 on success (library functional)
+int dbls_init() { return ensure_init(); }
+
+int dbls_hash_to_g2(const uint8_t* msg, u64 len, uint8_t out[96]) {
+    if (ensure_init()) return -100;
+    g2pt q;
+    hash_to_g2_point(q, msg, (size_t)len);
+    g2aff a = g2_to_aff(q);
+    g2_serialize(out, a);
+    return 0;
+}
+
+int dbls_hash_to_g1(const uint8_t* msg, u64 len, uint8_t out[48]) {
+    if (ensure_init()) return -100;
+    g1pt q;
+    hash_to_g1_point(q, msg, (size_t)len);
+    g1aff a = g1_to_aff(q);
+    g1_serialize(out, a);
+    return 0;
+}
+
+// sig = sk * H(msg); sk is 32 big-endian bytes (mod r)
+int dbls_sign(const uint8_t* msg, u64 len, const uint8_t sk[32],
+              uint8_t out[96]) {
+    if (ensure_init()) return -100;
+    g2pt h, s;
+    hash_to_g2_point(h, msg, (size_t)len);
+    u64 e[4];
+    scalar_from_bytes(e, sk);
+    pt_mul_limbs(s, h, e, 4);
+    g2aff a = g2_to_aff(s);
+    g2_serialize(out, a);
+    return 0;
+}
+
+// 1 = valid, 0 = invalid signature, <0 = malformed encodings
+int dbls_verify(const uint8_t pk[48], const uint8_t* msg, u64 len,
+                const uint8_t sig[96]) {
+    if (ensure_init()) return -100;
+    g1aff pka;
+    int rc = g1_deserialize(pka, pk, 1);
+    if (rc) return rc;
+    g2aff siga;
+    rc = g2_deserialize(siga, sig, 1);
+    if (rc) return rc;
+    if (siga.inf) return 0;                       // identity sig rejected
+    g2pt h;
+    hash_to_g2_point(h, msg, (size_t)len);
+    g2aff ha = g2_to_aff(h);
+    // e(-G1, sig) * e(pk, H(m)) == 1
+    g1aff ng;
+    ng.x = G1_GX; fp_neg(ng.y, G1_GY); ng.inf = false;
+    fp12 f = FP12_ONE_, res;
+    miller_accumulate(f, ng, siga);
+    miller_accumulate(f, pka, ha);
+    final_exponentiation(res, f);
+    return fp12_eq(res, FP12_ONE_) ? 1 : 0;
+}
+
+// verify with a precomputed (trusted, already-subgroup) H(m) point
+int dbls_verify_pre(const uint8_t pk[48], const uint8_t hm[96],
+                    const uint8_t sig[96]) {
+    if (ensure_init()) return -100;
+    g1aff pka;
+    int rc = g1_deserialize(pka, pk, 1);
+    if (rc) return rc;
+    g2aff siga, ha;
+    rc = g2_deserialize(siga, sig, 1);
+    if (rc) return rc;
+    rc = g2_deserialize(ha, hm, 0);               // trusted: skip subgroup
+    if (rc) return rc;
+    if (siga.inf) return 0;
+    g1aff ng;
+    ng.x = G1_GX; fp_neg(ng.y, G1_GY); ng.inf = false;
+    fp12 f = FP12_ONE_, res;
+    miller_accumulate(f, ng, siga);
+    miller_accumulate(f, pka, ha);
+    final_exponentiation(res, f);
+    return fp12_eq(res, FP12_ONE_) ? 1 : 0;
+}
+
+// out = sum scalars[i] * points[i]; points 48B compressed, scalars 32B BE.
+// check!=0 validates each point's subgroup membership.
+int dbls_g1_msm(const uint8_t* pts, const uint8_t* scalars, u64 n, int check,
+                uint8_t out[48]) {
+    if (ensure_init()) return -100;
+    if (n == 0 || n > 100000) return -1;
+    fp* xs = new fp[n];
+    fp* ys = new fp[n];
+    bool* infs = new bool[n];
+    u64 (*es)[4] = new u64[n][4];
+    int rc = 0;
+    for (u64 i = 0; i < n && rc == 0; ++i) {
+        g1aff a;
+        rc = g1_deserialize(a, pts + i * 48, check);
+        if (rc) break;
+        xs[i] = a.x; ys[i] = a.y; infs[i] = a.inf;
+        scalar_from_bytes(es[i], scalars + i * 32);
+    }
+    if (rc == 0) {
+        g1pt res;
+        msm_run<OpsFp>(res, xs, ys, infs, es, (size_t)n);
+        g1aff a = g1_to_aff(res);
+        g1_serialize(out, a);
+    }
+    delete[] xs; delete[] ys; delete[] infs; delete[] es;
+    return rc;
+}
+
+int dbls_g2_msm(const uint8_t* pts, const uint8_t* scalars, u64 n, int check,
+                uint8_t out[96]) {
+    if (ensure_init()) return -100;
+    if (n == 0 || n > 100000) return -1;
+    fp2* xs = new fp2[n];
+    fp2* ys = new fp2[n];
+    bool* infs = new bool[n];
+    u64 (*es)[4] = new u64[n][4];
+    int rc = 0;
+    for (u64 i = 0; i < n && rc == 0; ++i) {
+        g2aff a;
+        rc = g2_deserialize(a, pts + i * 96, check);
+        if (rc) break;
+        xs[i] = a.x; ys[i] = a.y; infs[i] = a.inf;
+        scalar_from_bytes(es[i], scalars + i * 32);
+    }
+    if (rc == 0) {
+        g2pt res;
+        msm_run<OpsFp2>(res, xs, ys, infs, es, (size_t)n);
+        g2aff a = g2_to_aff(res);
+        g2_serialize(out, a);
+    }
+    delete[] xs; delete[] ys; delete[] infs; delete[] es;
+    return rc;
+}
+
+// out = scalar * point (point NULL -> group generator)
+int dbls_g1_mul(const uint8_t* pt48, const uint8_t sk[32], uint8_t out[48]) {
+    if (ensure_init()) return -100;
+    g1aff a;
+    if (pt48) {
+        int rc = g1_deserialize(a, pt48, 1);
+        if (rc) return rc;
+    } else {
+        a.x = G1_GX; a.y = G1_GY; a.inf = false;
+    }
+    u64 e[4];
+    scalar_from_bytes(e, sk);
+    g1pt p = a.inf ? pt_infinity<OpsFp>() : pt_from_affine<OpsFp>(a.x, a.y);
+    g1pt r;
+    pt_mul_limbs(r, p, e, 4);
+    g1aff ra = g1_to_aff(r);
+    g1_serialize(out, ra);
+    return 0;
+}
+
+int dbls_g2_mul(const uint8_t* pt96, const uint8_t sk[32], uint8_t out[96]) {
+    if (ensure_init()) return -100;
+    g2aff a;
+    if (pt96) {
+        int rc = g2_deserialize(a, pt96, 1);
+        if (rc) return rc;
+    } else {
+        a.x = G2_GX; a.y = G2_GY; a.inf = false;
+    }
+    u64 e[4];
+    scalar_from_bytes(e, sk);
+    g2pt p = a.inf ? pt_infinity<OpsFp2>() : pt_from_affine<OpsFp2>(a.x, a.y);
+    g2pt r;
+    pt_mul_limbs(r, p, e, 4);
+    g2aff ra = g2_to_aff(r);
+    g2_serialize(out, ra);
+    return 0;
+}
+
+// point validation: 0 ok (incl. infinity), <0 malformed/off-curve/subgroup
+int dbls_g1_check(const uint8_t pt48[48]) {
+    if (ensure_init()) return -100;
+    g1aff a;
+    return g1_deserialize(a, pt48, 1);
+}
+
+int dbls_g2_check(const uint8_t pt96[96]) {
+    if (ensure_init()) return -100;
+    g2aff a;
+    return g2_deserialize(a, pt96, 1);
+}
+
+// g1 + g1 / g2 + g2 (compressed in/out) — protocol-plane group ops
+int dbls_g1_add(const uint8_t a48[48], const uint8_t b48[48],
+                uint8_t out[48]) {
+    if (ensure_init()) return -100;
+    g1aff a, b;
+    int rc = g1_deserialize(a, a48, 0);
+    if (rc) return rc;
+    rc = g1_deserialize(b, b48, 0);
+    if (rc) return rc;
+    g1pt pa = a.inf ? pt_infinity<OpsFp>() : pt_from_affine<OpsFp>(a.x, a.y);
+    if (!b.inf) pt_add_affine(pa, pa, b.x, b.y);
+    g1aff ra = g1_to_aff(pa);
+    g1_serialize(out, ra);
+    return 0;
+}
+
+int dbls_g2_add(const uint8_t a96[96], const uint8_t b96[96],
+                uint8_t out[96]) {
+    if (ensure_init()) return -100;
+    g2aff a, b;
+    int rc = g2_deserialize(a, a96, 0);
+    if (rc) return rc;
+    rc = g2_deserialize(b, b96, 0);
+    if (rc) return rc;
+    g2pt pa = a.inf ? pt_infinity<OpsFp2>() : pt_from_affine<OpsFp2>(a.x, a.y);
+    if (!b.inf) pt_add_affine(pa, pa, b.x, b.y);
+    g2aff ra = g2_to_aff(pa);
+    g2_serialize(out, ra);
+    return 0;
+}
+
+// full pairing e(P,Q) -> canonical 576-byte GT (12 x 48B BE, tower order
+// c0.c0.c0, c0.c0.c1, c0.c1.c0, ..., c1.c2.c1) — refimpl cross-check hook
+int dbls_pairing(const uint8_t p48[48], const uint8_t q96[96],
+                 uint8_t out[576]) {
+    if (ensure_init()) return -100;
+    g1aff p;
+    int rc = g1_deserialize(p, p48, 1);
+    if (rc) return rc;
+    g2aff q;
+    rc = g2_deserialize(q, q96, 1);
+    if (rc) return rc;
+    fp12 g;
+    pairing_full(g, p, q);
+    const fp2* cs[6] = {&g.c0.c0, &g.c0.c1, &g.c0.c2,
+                        &g.c1.c0, &g.c1.c1, &g.c1.c2};
+    for (int i = 0; i < 6; ++i) {
+        fp_to_bytes(out + i * 96, cs[i]->c0);
+        fp_to_bytes(out + i * 96 + 48, cs[i]->c1);
+    }
+    return 0;
+}
+
+// internal coherence check: bilinearity + hash/codec round trips.
+int dbls_selfcheck() {
+    if (ensure_init()) return -100;
+    // pairing bilinearity: e(aG1, bG2) == e(G1, G2)^(ab), via e(aG1,bG2) ==
+    // e(abG1, G2) and non-degeneracy
+    uint8_t a_sc[32], b_sc[32], ab_sc[32];
+    memset(a_sc, 0, 32); memset(b_sc, 0, 32); memset(ab_sc, 0, 32);
+    a_sc[31] = 5; b_sc[31] = 7; ab_sc[31] = 35;
+    uint8_t pa[48], qb[96], pab[48], g1b[48], g2b[96];
+    g1aff g1g; g1g.x = G1_GX; g1g.y = G1_GY; g1g.inf = false;
+    g2aff g2g; g2g.x = G2_GX; g2g.y = G2_GY; g2g.inf = false;
+    g1_serialize(g1b, g1g);
+    g2_serialize(g2b, g2g);
+    if (dbls_g1_mul(nullptr, a_sc, pa)) return -1;
+    if (dbls_g2_mul(nullptr, b_sc, qb)) return -2;
+    if (dbls_g1_mul(nullptr, ab_sc, pab)) return -3;
+    uint8_t e1[576], e2[576], e3[576];
+    if (dbls_pairing(pa, qb, e1)) return -4;
+    if (dbls_pairing(pab, g2b, e2)) return -5;
+    if (memcmp(e1, e2, 576) != 0) return -6;
+    if (dbls_pairing(g1b, g2b, e3)) return -7;
+    if (memcmp(e1, e3, 576) == 0) return -8;      // non-degeneracy
+    // sign/verify round trip
+    uint8_t sk[32];
+    memset(sk, 0, 32);
+    sk[31] = 42; sk[0] = 1;
+    uint8_t pk[48], sig[96];
+    if (dbls_g1_mul(nullptr, sk, pk)) return -9;
+    const uint8_t msg[] = "dbls-selfcheck";
+    if (dbls_sign(msg, sizeof msg - 1, sk, sig)) return -10;
+    if (dbls_verify(pk, msg, sizeof msg - 1, sig) != 1) return -11;
+    sig[95] ^= 1;
+    int rc = dbls_verify(pk, msg, sizeof msg - 1, sig);
+    if (rc == 1) return -12;                      // tampered must not verify
+    return 0;
+}
+
+}  // extern "C"

@@ -79,7 +79,8 @@ async def _close(writer: asyncio.StreamWriter) -> None:
 
 async def start_mux(port: int, grpc_port: int, rest_port: int,
                     host: str = "0.0.0.0",
-                    ssl_context=None) -> MuxServer:
+                    ssl_context=None,
+                    sniff_timeout: float = 10.0) -> MuxServer:
     """Serve `port`, splicing gRPC to 127.0.0.1:grpc_port and everything
     else to 127.0.0.1:rest_port.  `ssl_context` (server-side, ALPN is
     configured here) makes the single port TLS like the reference's
@@ -100,7 +101,7 @@ async def start_mux(port: int, grpc_port: int, rest_port: int,
             # readexactly: a preface split across TCP segments/TLS records
             # must not be classified on a short read
             head = await asyncio.wait_for(
-                reader.readexactly(4), timeout=10.0
+                reader.readexactly(4), timeout=sniff_timeout
             )
         except (asyncio.TimeoutError, asyncio.IncompleteReadError,
                 OSError):

@@ -67,7 +67,8 @@ def sharded_pairing_check(mesh: Mesh):
     )
 
 
-def _sharded_msm(points, bits, *, mesh: Mesh, F: FieldOps):
+def _sharded_msm(points, bits, *, mesh: Mesh, F: FieldOps,
+                 per_device: bool = False):
     axis = mesh.axis_names[0]
 
     def local(points, bits):
@@ -77,21 +78,25 @@ def _sharded_msm(points, bits, *, mesh: Mesh, F: FieldOps):
         out = gathered[0]
         for i in range(1, gathered.shape[0]):
             out = point_add(out, gathered[i], F)
-        return out
+        return out[None] if per_device else out
 
     # check_vma=False: after all_gather every device holds the same sum,
     # but the varying-axis checker cannot prove replication of a value
-    # computed from gathered shards.
+    # computed from gathered shards.  The replication claim is instead
+    # EVIDENCED by tests/test_shard.py::test_sharded_msm_replication,
+    # which runs this same body with per_device=True (out_specs sharded,
+    # one combined sum per device) and asserts all devices agree.
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(mesh.axis_names[0]), P(mesh.axis_names[0])),
-        out_specs=P(),
+        in_specs=(P(axis), P(axis)),
+        out_specs=P(axis) if per_device else P(),
         check_vma=False,
     )(points, bits)
 
 
-def sharded_msm(mesh: Mesh, points, bits, F: FieldOps = F2):
+def sharded_msm(mesh: Mesh, points, bits, F: FieldOps = F2,
+                per_device: bool = False):
     """sum_i bits_i * points_i with points sharded across the mesh.
 
     points: (B, 3, *field_shape), bits: (B, 256) MSB-first; B is padded
@@ -101,6 +106,10 @@ def sharded_msm(mesh: Mesh, points, bits, F: FieldOps = F2):
     add on every device (tensor-parallel Lagrange recovery — reference:
     kyber `share.RecoverCommit` consumed at
     /root/reference/beacon/beacon.go:488).
+
+    per_device=True returns the (n_dev, 3, ...) per-device combined sums
+    instead of the replicated value — the test hook proving every device
+    computed the same answer.
     """
     n = mesh.devices.size
     b = points.shape[0]
@@ -116,12 +125,14 @@ def sharded_msm(mesh: Mesh, points, bits, F: FieldOps = F2):
     shard = batch_sharding(mesh)
     points = jax.device_put(points, shard)
     bits = jax.device_put(bits, shard)
-    key = (mesh, F.name)
+    key = (mesh, F.name, per_device)
     fn = _MSM_CACHE.get(key)
     if fn is None:
         # jit caches by function identity — a fresh partial per call
         # would recompile every invocation
-        fn = jax.jit(partial(_sharded_msm, mesh=mesh, F=F))
+        fn = jax.jit(
+            partial(_sharded_msm, mesh=mesh, F=F, per_device=per_device)
+        )
         _MSM_CACHE[key] = fn
     return fn(points, bits)
 

@@ -89,7 +89,7 @@ def build_network(n, t, clock, scheme=None, seed=5):
     )
     poly = PriPoly.random(t, rng=r.randbytes)
     commits = poly.commit().commits
-    scheme = scheme or tbls.RefScheme()
+    scheme = scheme or tbls._native_scheme_or_ref()
     net = LocalNet()
     handlers = []
     for i, pair in enumerate(pairs):
@@ -148,7 +148,7 @@ async def test_beacon_simple_rounds():
     await wait_for_round(handlers, 3)
 
     dist_key = ref.g1_mul(ref.G1_GEN, poly.secret())
-    scheme = tbls.RefScheme()
+    scheme = tbls._native_scheme_or_ref()
     for h in handlers:
         head = h.store.last()
         assert head is not None and head.round >= 2, \
@@ -191,7 +191,7 @@ async def test_beacon_threshold_with_down_node_and_catchup():
     # chain it synced is verifiable
     dist_key = ref.g1_mul(ref.G1_GEN, poly.secret())
     for rnd in range(1, head.round + 1):
-        verify_beacon(tbls.RefScheme(), dist_key, late.store.get(rnd))
+        verify_beacon(tbls._native_scheme_or_ref(), dist_key, late.store.get(rnd))
     # and it now participates in new rounds
     await clock.advance(PERIOD)
     await wait_for_round([late], head.round + 1)
@@ -283,7 +283,7 @@ async def test_sync_rejects_tampered_chain():
     # nothing invalid was stored
     for rnd in range(1, (late.store.last() or genesis_beacon(b"")).round + 1):
         verify_beacon(
-            tbls.RefScheme(),
+            tbls._native_scheme_or_ref(),
             ref.g1_mul(ref.G1_GEN, poly.secret()),
             late.store.get(rnd),
         )

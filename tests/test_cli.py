@@ -22,7 +22,7 @@ def run_cli(args, folder, **kw):
     env["PYTHONPATH"] = str(REPO)
     # subprocess daemons must not pay a JAX/accelerator init (the
     # --backend auto default would); the protocol tier is scheme-agnostic
-    env.setdefault("DRAND_TPU_BACKEND", "ref")
+    env.setdefault("DRAND_TPU_BACKEND", "native")
     return subprocess.run(
         [sys.executable, "-m", "drand_tpu.cli",
          "--folder", str(folder), *args],
@@ -99,7 +99,7 @@ def test_daemon_lifecycle_and_dkg(tmp_path):
     env["PYTHONPATH"] = str(REPO)
     # subprocess daemons must not pay a JAX/accelerator init (the
     # --backend auto default would); the protocol tier is scheme-agnostic
-    env.setdefault("DRAND_TPU_BACKEND", "ref")
+    env.setdefault("DRAND_TPU_BACKEND", "native")
     procs = []
     try:
         for i, f in enumerate(folders):

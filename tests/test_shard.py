@@ -89,3 +89,47 @@ def test_sharded_msm_recovery(mesh, t):
     )
     out = sharded_msm(mesh, enc, bits, F2)
     assert curve.g2_decode(out) == ref.g2_mul(ref.G2_GEN, secret)
+
+
+def _msm_inputs(t, seed):
+    """t G2 points + 256-bit scalars with a known oracle answer."""
+    rngl = np.random.RandomState(seed)
+    pts, scalars, acc = [], [], None
+    for i in range(t):
+        k = int(rngl.randint(1, 1 << 30)) * (i + 1) + 7
+        s = (int(rngl.randint(1, 1 << 30)) << 96 | 0xBEEF + i) % ref.R
+        p = ref.g2_mul(ref.G2_GEN, k)
+        pts.append(p)
+        scalars.append(s)
+        acc = ref.g2_add(acc, ref.g2_mul(p, s))
+    enc = jnp.stack([curve.g2_encode(p) for p in pts])
+    bits = jnp.asarray(np.stack([curve.scalar_to_bits(s) for s in scalars]))
+    return enc, bits, acc
+
+
+@pytest.mark.parametrize("ndev,t", [(2, 3), (4, 5), (8, 1), (8, 11)])
+def test_sharded_msm_matches_unsharded(ndev, t):
+    """Round-3 VERDICT Weak #4: cross-check the sharded MSM against the
+    unsharded kernel across mesh sizes and committee sizes that exercise
+    the identity-padding path (3-on-2, 5-on-4, 1-on-8, 11-on-8)."""
+    from drand_tpu.ops.msm import g2_msm
+
+    enc, bits, want = _msm_inputs(t, seed=100 + 10 * ndev + t)
+    m = device_mesh(ndev)
+    sharded = curve.g2_decode(sharded_msm(m, enc, bits, F2))
+    unsharded = curve.g2_decode(g2_msm(enc, bits))
+    assert sharded == unsharded == want
+
+
+def test_sharded_msm_replication(mesh):
+    """The production shard_map runs with check_vma=False and
+    out_specs=P() — an unverified replication claim.  Run the SAME body
+    with per-device outputs and assert every device combined to the same
+    group element (and the right one)."""
+    enc, bits, want = _msm_inputs(6, seed=77)   # 6 on 8: padding too
+    per_dev = np.asarray(sharded_msm(mesh, enc, bits, F2, per_device=True))
+    assert per_dev.shape[0] == N_DEV
+    first = per_dev[0]
+    for i in range(1, N_DEV):
+        np.testing.assert_array_equal(per_dev[i], first)
+    assert curve.g2_decode(jnp.asarray(first)) == want
