@@ -30,6 +30,9 @@ Environment knobs:
   BENCH_PROFILE_DIR  write a JAX profiler trace of the timed iterations
                      here (inspect with xprof/tensorboard) — the
                      per-kernel breakdown VERDICT r3 asked for
+  DRAND_TPU_PALLAS_CONV  in-kernel conv backend: "vpu" (default),
+                     "mxu" (REDC const-convs as bf16-split MXU matmuls),
+                     "kara" (17/17 Karatsuba data conv), "mxu+kara"
 
 If the ambient accelerator backend is broken (the axon TPU tunnel can
 either raise at init or hang indefinitely — BENCH_r02 recorded rc=1 with
@@ -229,6 +232,7 @@ def main() -> None:
             "includes_hash_to_curve": not device_only,
             "batch": batch,
             "kernel": kernel,
+            "conv": os.environ.get("DRAND_TPU_PALLAS_CONV", "vpu"),
             "iters": iters,
             "seconds": round(dt, 3),
             "device": str(jax.devices()[0]),

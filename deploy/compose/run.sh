@@ -55,17 +55,22 @@ echo "    head at round $round"
 echo "[+] asserting the chain advances"
 next=$(( round + 1 ))
 deadline=$(( $(date +%s) + 120 ))
+r2=0
 while [ "$(date +%s)" -lt "$deadline" ]; do
-    r2=$(api 18081 public | python3 -c 'import json,sys; print(json.load(sys.stdin)["round"])')
-    [ "$r2" -ge "$next" ] && break
+    # guard every curl/parse: a transient REST hiccup must retry, not
+    # abort through set -e without the fail() diagnostics
+    if out=$(api 18081 public 2>/dev/null); then
+        r2=$(echo "$out" | python3 -c 'import json,sys; print(json.load(sys.stdin)["round"])' 2>/dev/null || echo 0)
+        [ "$r2" -ge "$next" ] && break
+    fi
     sleep 5
 done
 [ "$r2" -ge "$next" ] || fail "chain stuck at round $round"
 echo "    advanced to round $r2"
 
 echo "[+] asserting two nodes agree on round $round"
-a=$(api 18081 "public/$round" | python3 -c 'import json,sys; print(json.load(sys.stdin)["randomness"])')
-b=$(api 18083 "public/$round" | python3 -c 'import json,sys; print(json.load(sys.stdin)["randomness"])')
+a=$(api 18081 "public/$round" | python3 -c 'import json,sys; print(json.load(sys.stdin)["randomness"])' 2>/dev/null) || fail "fetch round $round from node1"
+b=$(api 18083 "public/$round" | python3 -c 'import json,sys; print(json.load(sys.stdin)["randomness"])' 2>/dev/null) || fail "fetch round $round from node3"
 [ -n "$a" ] && [ "$a" = "$b" ] || fail "nodes disagree: $a vs $b"
 echo "    agreed: ${a:0:16}..."
 

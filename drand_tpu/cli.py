@@ -195,7 +195,9 @@ def cmd_warmup(args) -> int:
         try:
             probe = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", "120")),
+                # same knob + default as bench.py: a loaded single-core
+                # host can legitimately take minutes to answer the probe
+                timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", "240")),
                 capture_output=True,
             )
             alive = probe.returncode == 0

@@ -608,6 +608,11 @@ def default_scheme(backend: Optional[str] = None) -> Scheme:
         _DEFAULT = _native_scheme_or_ref()
     elif backend == "ref":
         _DEFAULT = RefScheme()
+    elif backend is not None:
+        raise ValueError(
+            f"unknown crypto backend {backend!r}: "
+            "expected auto, jax, native or ref"
+        )
     elif _DEFAULT is None:
         _DEFAULT = _native_scheme_or_ref()
     return _DEFAULT

@@ -268,23 +268,25 @@ def _u_tuple(u_ref, k):
     return (u_ref[off : off + NL], u_ref[off + NL : off + 2 * NL])
 
 
-def _hash_kernel(consts_ref, u_ref, out_ref):
+def _hash_kernel(consts_ref, toep_ref, u_ref, out_ref, *,
+                 conv: str = "vpu"):
     """u rows (4*NL, B) [u0.c0|u0.c1|u1.c0|u1.c1] -> affine point rows
     (4*NL, B) [x.c0|x.c1|y.c0|y.c1]."""
-    pp._CTX["consts"] = consts_ref[:]
+    pp._set_ctx(consts_ref, toep_ref, conv)
     x, y = _hash_point(_u_tuple(u_ref, 0), _u_tuple(u_ref, 1))
     out_ref[:] = jnp.concatenate([x[0], x[1], y[0], y[1]], axis=0)
     pp._CTX.clear()
 
 
-def _check_hashed_kernel(consts_ref, p_ref, q_ref, u_ref, out_ref):
+def _check_hashed_kernel(consts_ref, toep_ref, p_ref, q_ref, u_ref,
+                         out_ref, *, conv: str = "vpu"):
     """End-to-end verify: Q2 = H(m) in-kernel, then the product check.
 
     p_ref: (4*NL, B) G1 rows [p1.x|p1.y|p2.x|p2.y]
     q_ref: (4*NL, B) G2 rows of Q1 (the signature)
     u_ref: (4*NL, B) hash-to-field draws of the message
     """
-    pp._CTX["consts"] = consts_ref[:]
+    pp._set_ctx(consts_ref, toep_ref, conv)
     b = p_ref.shape[-1]
     q2 = _hash_point(_u_tuple(u_ref, 0), _u_tuple(u_ref, 1))
     ok = pp._product_check(
@@ -321,21 +323,29 @@ def _pad_batch(arrs, block):
     return arrs, bsz
 
 
-@functools.partial(jax.jit, static_argnames=("block", "interpret"))
-def hash_to_g2(u0, u1, block: int = 128, interpret: bool = False):
+@functools.partial(jax.jit,
+                   static_argnames=("block", "interpret", "conv"))
+def hash_to_g2(u0, u1, block: int = 128, interpret: bool = False,
+               conv: str | None = None):
     """Batched device hash: field draws (B, 2, NL) Montgomery ->
     affine G2 points (B, 2, 2, NL)."""
+    if conv is None:
+        conv = pp.CONV_MODE_DEFAULT
     (u0, u1), bsz = _pad_batch([u0, u1], block)
     n = u0.shape[0]
     u_all = jnp.concatenate([_rows_fp2(u0), _rows_fp2(u1)], axis=0)
     nconst = pp.CONSTS_NP.shape[0]
     out = pl.pallas_call(
-        _hash_kernel,
+        functools.partial(_hash_kernel, conv=conv),
         out_shape=jax.ShapeDtypeStruct((4 * NL, n), jnp.int32),
         grid=(n // block,),
         in_specs=[
             pl.BlockSpec(
                 (nconst, NL, 1), lambda i: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (3 * NL - 1, NL), lambda i: (0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
@@ -350,20 +360,24 @@ def hash_to_g2(u0, u1, block: int = 128, interpret: bool = False):
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
-    )(jnp.asarray(pp.CONSTS_NP), u_all)
+    )(jnp.asarray(pp.CONSTS_NP), jnp.asarray(pp.TOEP_NP_ARR), u_all)
     # (4*NL, n) -> (B, 2, 2, NL)
     pts = jnp.moveaxis(out.reshape(2, 2, NL, n), -1, 0)
     return pts[:bsz]
 
 
-@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("block", "interpret", "conv"))
 def pairing_product_check_hashed(p1, q1, p2, u0, u1, block: int = 128,
-                                 interpret: bool = False):
+                                 interpret: bool = False,
+                                 conv: str | None = None):
     """e(P1, Q1) · e(P2, H(u)) == 1 with the hash computed in-kernel.
 
     p1/p2: (B, 2, NL) affine G1; q1: (B, 2, 2, NL) affine G2;
     u0/u1: (B, 2, NL) hash-to-field draws.  Returns bool (B,).
     """
+    if conv is None:
+        conv = pp.CONV_MODE_DEFAULT
     (p1, q1, p2, u0, u1), bsz = _pad_batch([p1, q1, p2, u0, u1], block)
     n = p1.shape[0]
 
@@ -379,12 +393,16 @@ def pairing_product_check_hashed(p1, q1, p2, u0, u1, block: int = 128,
 
     nconst = pp.CONSTS_NP.shape[0]
     out = pl.pallas_call(
-        _check_hashed_kernel,
+        functools.partial(_check_hashed_kernel, conv=conv),
         out_shape=jax.ShapeDtypeStruct((8, n), jnp.int32),
         grid=(n // block,),
         in_specs=[
             pl.BlockSpec(
                 (nconst, NL, 1), lambda i: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (3 * NL - 1, NL), lambda i: (0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
@@ -407,5 +425,5 @@ def pairing_product_check_hashed(p1, q1, p2, u0, u1, block: int = 128,
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
-    )(jnp.asarray(pp.CONSTS_NP), p_all, q_all, u_all)
+    )(jnp.asarray(pp.CONSTS_NP), jnp.asarray(pp.TOEP_NP_ARR), p_all, q_all, u_all)
     return out[0, :bsz] != 0

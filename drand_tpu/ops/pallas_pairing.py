@@ -28,6 +28,7 @@ populated once at kernel entry (single-threaded tracing).
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 import jax
@@ -137,8 +138,52 @@ CONSTS_NP = np.stack(
     [np.array(_CONSTS[n], dtype=np.int32) for n in _CONST_ORDER], axis=0
 )[:, :, None]
 
-#: populated at kernel entry: {"consts": (K, NL, 1) array}
+
+def _toeplitz(limbs, width: int, nrows: int = NL) -> np.ndarray:
+    """Constant-convolution matrix: (T @ a)[k] = sum_i a[i]*limbs[k-i]."""
+    t = np.zeros((width, nrows), np.int32)
+    for k in range(width):
+        for i in range(nrows):
+            j = k - i
+            if 0 <= j < len(limbs):
+                t[k, i] = limbs[j]
+    return t
+
+
+#: stacked [T_NP (NL rows); T_P (2*NL-1 rows)] — the two constant REDC
+#: convolutions as matrices, shipped to the kernel so the `mxu` conv mode
+#: can run them on the systolic array instead of 34 VPU multiply-adds
+TOEP_NP_ARR = np.concatenate(
+    [_toeplitz(NP_L, NL), _toeplitz(P_L, 2 * NL - 1)], axis=0
+)
+
+#: default in-kernel constant-conv backend: "vpu" (shifted multiply-adds)
+#: or "mxu" (bf16-split matmuls against the Toeplitz constants).
+#: Overridable per call via pairing_product_check(conv=...).
+CONV_MODE_DEFAULT = os.environ.get("DRAND_TPU_PALLAS_CONV", "vpu")
+
+#: populated at kernel entry: {"consts": (K, NL, 1) array, optional
+#: Toeplitz splits "TNP_hi/lo", "TP_hi/lo" when conv == "mxu"}
 _CTX = {}
+
+
+def _set_ctx(consts_ref, toep_ref, conv: str) -> None:
+    """Populate the in-kernel context (single-threaded tracing).
+
+    `conv` is a mode string: "mxu" routes the constant REDC convolutions
+    to the systolic array, "kara" splits the data convolution 17/17
+    Karatsuba-style (25% fewer multiply rows); "mxu+kara" combines both.
+    """
+    _CTX["consts"] = consts_ref[:]
+    _CTX["conv"] = conv
+    if "mxu" in conv:
+        t = toep_ref[:]
+        for name, m in (("TNP", t[:NL]), ("TP", t[NL:])):
+            # 6-bit digit split: every entry < 64 is exact in bfloat16,
+            # and every dot-product partial sum (< 34*64*64 < 2^18) is
+            # exact in the MXU's f32 accumulation
+            _CTX[f"{name}_hi"] = (m >> 6).astype(jnp.bfloat16)
+            _CTX[f"{name}_lo"] = (m & 63).astype(jnp.bfloat16)
 
 
 def _cc(name):
@@ -223,18 +268,68 @@ def _padded(term, lo, width):
     return jnp.concatenate(parts, axis=0)
 
 
-def _conv(a, b):
-    """Schoolbook product (NL,B)x(NL,B) -> (2*NL-1,B) columns."""
-    width = 2 * NL - 1
+def _conv_rows(a, b, width):
+    """Shifted multiply-accumulate product of equal-row operands."""
     t = None
-    for j in range(NL):
+    for j in range(b.shape[0]):
         term = _padded(a * b[j : j + 1], j, width)
         t = term if t is None else t + term
     return t
 
 
+def _conv(a, b):
+    """Schoolbook product (NL,B)x(NL,B) -> (2*NL-1,B) columns.
+
+    "kara" conv mode: one 17/17 Karatsuba split — 3 half-convolutions
+    (3*17^2 = 867 multiply rows vs 34^2 = 1156).  Bounds: half-sum limbs
+    <= 2B+1, so middle-product columns stay < 17*(2B+1)^2 < 2^30.1, inside
+    the 3-pass carry budget; all assembled columns are non-negative.
+    """
+    width = 2 * NL - 1
+    if "kara" in _CTX.get("conv", ""):
+        h = NL // 2                      # 17
+        a0, a1 = a[:h], a[h:]
+        b0, b1 = b[:h], b[h:]
+        wh = 2 * h - 1                   # 33
+        t0 = _conv_rows(a0, b0, wh)
+        t2 = _conv_rows(a1, b1, wh)
+        tm = _conv_rows(a0 + a1, b0 + b1, wh)
+        t1 = tm - t0 - t2                # >= 0 per column (cross terms)
+        out = _padded(t0, 0, width)
+        out = out + _padded(t1, h, width)
+        out = out + _padded(t2, 2 * h, width)
+        return out
+    return _conv_rows(a, b, width)
+
+
 def _conv_const(a, limbs, width):
-    """Product with a constant (python-int limbs), truncated to width."""
+    """Product with a constant (python-int limbs), truncated to width.
+
+    In `mxu` conv mode the two REDC constants (NP_L at width NL, P_L at
+    width 2*NL-1) run as bf16-split matmuls against their Toeplitz
+    matrices on the systolic array — 4 small matmuls replacing 34 VPU
+    multiply-adds; all values stay exact (see _set_ctx)."""
+    if "TNP_hi" in _CTX and a.shape[0] == NL:
+        key = None
+        if limbs is NP_L and width == NL:
+            key = "TNP"
+        elif limbs is P_L and width == 2 * NL - 1:
+            key = "TP"
+        if key is not None:
+            a_hi = (a >> 6).astype(jnp.bfloat16)
+            a_lo = (a & 63).astype(jnp.bfloat16)
+            dn = (((1,), (0,)), ((), ()))
+
+            def mm(t, x):
+                return lax.dot_general(
+                    t, x, dn, preferred_element_type=jnp.float32
+                )
+
+            t_hi, t_lo = _CTX[f"{key}_hi"], _CTX[f"{key}_lo"]
+            hh = mm(t_hi, a_hi).astype(jnp.int32)
+            mid = (mm(t_hi, a_lo) + mm(t_lo, a_hi)).astype(jnp.int32)
+            ll = mm(t_lo, a_lo).astype(jnp.int32)
+            return (hh << 12) + (mid << 6) + ll
     t = jnp.zeros((width, a.shape[1]), jnp.int32)
     for j, c in enumerate(limbs):
         if c == 0:
@@ -1015,6 +1110,60 @@ def _line_dbl(t, px, py):
     return a2, b2, c2
 
 
+def _dbl_and_line(t, px, py):
+    """Fused doubling-path Miller step: point_double2 + _line_dbl with
+    the first product wave shared (x², y², z², xy, yz computed once —
+    the separate ops recompute y² and z²).  Identical algebra, 2 fewer
+    fp2 squarings and 4 fewer REDCs per step; the doubling-only body
+    runs 58 of the 63 Miller iterations, so this is the hot step."""
+    x, y, z = t
+    b3 = _b3(x[0].shape[1])
+    r1 = _PRec()
+    s_x2 = r1.fp2_sqr(x)
+    s_y2 = r1.fp2_sqr(y)
+    s_z2 = r1.fp2_sqr(z)
+    s_xy = r1.fp2_mul(x, y)
+    s_yz = r1.fp2_mul(y, z)
+    x2 = _fp2_out(r1, s_x2)
+    t0 = _fp2_out(r1, s_y2)
+    t2 = _fp2_out(r1, s_z2)
+    txy = _fp2_out(r1, s_xy)
+    t1 = _fp2_out(r1, s_yz)
+    z3 = fp2_add(t0, t0)
+    z3 = fp2_add(z3, z3)
+    z3 = fp2_add(z3, z3)                  # 8 y^2
+
+    r2 = _PRec()
+    s_x3 = r2.fp2_mul(x2, x)
+    s_y2z = r2.fp2_mul(t0, z)
+    s_x2z = r2.fp2_mul(x2, z)
+    s_yz2 = r2.fp2_mul(t1, z)             # (yz)·z == y·z^2
+    s_t2b = r2.fp2_mul(b3, t2)
+    a2 = _fp2_out(r2, _pp_sub(
+        (s_x3[0].muls(3), s_x3[1].muls(3)),
+        (s_y2z[0].muls(2), s_y2z[1].muls(2)),
+    ))
+    tb = _fp2_out(r2, (s_x2z[0].muls(3), s_x2z[1].muls(3)))
+    tc = _fp2_out(r2, (s_yz2[0].muls(2), s_yz2[1].muls(2)))
+    t2b = _fp2_out(r2, s_t2b)
+    y3 = fp2_add(t0, t2b)
+    t0n = fp2_sub(t0, fp2_add(fp2_add(t2b, t2b), t2b))
+
+    r3 = _PRec()
+    p1 = r3.fp2_mul(t2b, z3)
+    p2 = r3.fp2_mul(t1, z3)
+    p3 = r3.fp2_mul(t0n, y3)
+    p4 = r3.fp2_mul(t0n, txy)
+    sb0, sb1 = r3.prod(tb[0], px), r3.prod(tb[1], px)
+    sc0, sc1 = r3.prod(tc[0], py), r3.prod(tc[1], py)
+    x3 = _fp2_out(r3, (p4[0].muls(2), p4[1].muls(2)))
+    y3n = _fp2_out(r3, _pp_add(p1, p3))
+    z3n = _fp2_out(r3, p2)
+    b2 = (r3.materialize(sb0.muls(-1)), r3.materialize(sb1.muls(-1)))
+    c2 = (r3.materialize(sc0), r3.materialize(sc1))
+    return (a2, b2, c2), (x3, y3n, z3n)
+
+
 def _line_add(t, xq, yq, px, py):
     """Chord-line coefficients through T and Q: 16 products, 10 REDCs."""
     x, y, z = t
@@ -1090,8 +1239,7 @@ def _miller(px, py, xq, yq, b):
 
     def dbl_step(state):
         f, t = state
-        a2, bb2, c2 = _line_dbl(t, px, py)
-        t = point_double2(t)
+        (a2, bb2, c2), t = _dbl_and_line(t, px, py)
         f = fp12_mul_by_line_lazy(fp12_sqr_lazy(f), a2, bb2, c2)
         return f, t
 
@@ -1161,10 +1309,12 @@ def _product_check(p1x, p1y, q1, p2x, p2y, q2, b):
     return ok
 
 
-def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
+def _check_kernel(consts_ref, toep_ref, p_ref, q_ref, out_ref, *,
+                  conv: str = "vpu"):
     """Batched product check over one block.
 
     consts_ref: (K, NL, 1) VMEM — limb constants (leading-dim indexed)
+    toep_ref: (3 * NL - 1, NL) VMEM — REDC Toeplitz constants (mxu conv)
     p_ref: (4 * NL, B)   G1 affine rows [p1.x | p1.y | p2.x | p2.y]
     q_ref: (8 * NL, B)   G2 affine rows [q1.x.c0 | q1.x.c1 | q1.y.c0 |
                          q1.y.c1 | q2...]
@@ -1174,7 +1324,7 @@ def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
     The two Miller loops run sequentially on single-width batches —
     doubling lanes and splitting mid-kernel trips Mosaic layout bugs.
     """
-    _CTX["consts"] = consts_ref[:]
+    _set_ctx(consts_ref, toep_ref, conv)
 
     b = p_ref.shape[-1]
     ok = _product_check(
@@ -1195,15 +1345,20 @@ def _check_kernel(consts_ref, p_ref, q_ref, out_ref):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("block", "interpret", "conv"))
 def pairing_product_check(p1, q1, p2, q2, block: int = 128,
-                          interpret: bool = False):
+                          interpret: bool = False,
+                          conv: str | None = None):
     """Batched e(P1,Q1)*e(P2,Q2)==1 via the Pallas mega-kernel.
 
     Inputs use the op-graph layout (batch-first, limbs-last):
       p*: (B, 2, NL)  affine G1,  q*: (B, 2, 2, NL) affine G2 (Montgomery)
+    conv: constant-conv backend ("vpu"/"mxu"); None = DRAND_TPU_PALLAS_CONV.
     Returns bool (B,).
     """
+    if conv is None:
+        conv = CONV_MODE_DEFAULT
     bsz = p1.shape[0]
     pad = (-bsz) % block
     if pad:
@@ -1228,12 +1383,16 @@ def pairing_product_check(p1, q1, p2, q2, block: int = 128,
 
     nconst = CONSTS_NP.shape[0]
     out = pl.pallas_call(
-        _check_kernel,
+        functools.partial(_check_kernel, conv=conv),
         out_shape=jax.ShapeDtypeStruct((8, n), jnp.int32),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec(
                 (nconst, NL, 1), lambda i: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (3 * NL - 1, NL), lambda i: (0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
@@ -1254,5 +1413,5 @@ def pairing_product_check(p1, q1, p2, q2, block: int = 128,
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
-    )(jnp.asarray(CONSTS_NP), p_all, q_all)
+    )(jnp.asarray(CONSTS_NP), jnp.asarray(TOEP_NP_ARR), p_all, q_all)
     return out[0, :bsz] != 0

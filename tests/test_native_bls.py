@@ -172,7 +172,11 @@ def test_native_chain_batch_verify():
 
 def test_default_scheme_auto_prefers_native_on_cpu(monkeypatch):
     monkeypatch.setattr(tbls, "_accelerator_present", lambda: False)
-    s = tbls.default_scheme("auto")
-    assert isinstance(s, tbls.NativeScheme)
-    # restore the ref default other tests may rely on
-    tbls.default_scheme("ref")
+    prior = tbls._DEFAULT
+    try:
+        s = tbls.default_scheme("auto")
+        assert isinstance(s, tbls.NativeScheme)
+        with pytest.raises(ValueError):
+            tbls.default_scheme("cuda")
+    finally:
+        tbls._DEFAULT = prior

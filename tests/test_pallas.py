@@ -281,3 +281,107 @@ def test_bit_patterns_match():
             pos = nbits - 1 - i
             got = (words[pos >> 4] >> (pos & 15)) & 1
             assert got == int(bits[i]), (name, i)
+
+
+def run_rows_conv(fn, out_rows, conv, *arrays):
+    """run_rows with an explicit constant-conv mode (mxu/kara paths)."""
+
+    def kern(consts_ref, toep_ref, *refs):
+        out_ref = refs[-1]
+        ins = [r[:] for r in refs[:-1]]
+        pp._set_ctx(consts_ref, toep_ref, conv)
+        out_ref[:] = fn(*ins)
+        pp._CTX.clear()
+
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((out_rows, B), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)]
+        * (2 + len(arrays)),
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=True,
+    )(jnp.asarray(pp.CONSTS_NP), jnp.asarray(pp.TOEP_NP_ARR), *arrays)
+
+
+@pytest.mark.parametrize("conv", ["mxu", "kara", "mxu+kara"])
+def test_conv_modes_match_vpu(conv):
+    """The MXU const-conv and Karatsuba data-conv modes must agree with
+    the schoolbook VPU path on every decoded value (round-4 perf levers;
+    exactness argument in pallas_pairing._set_ctx/_conv)."""
+    xs, a = rand_cols()
+    ys, b = rand_cols()
+    got = np.asarray(run_rows_conv(pp.f_mul, pp.NL, conv, a, b))
+    assert [decode(got[:, i]) for i in range(B)] == [
+        x * y * fp.R_MONT % ref.P for x, y in zip(xs, ys)
+    ]
+
+    def lazy(u, v):
+        return pp.f_redc(pp.f_mul_wide(u, v))
+
+    got = np.asarray(run_rows_conv(lazy, pp.NL, conv, a, b))
+    assert [decode(got[:, i]) for i in range(B)] == [
+        x * y * fp.R_MONT % ref.P for x, y in zip(xs, ys)
+    ]
+
+
+def test_conv_const_mxu_limb_boundaries():
+    """The bf16 6-bit digit split must survive the extreme limb values a
+    carried operand can hold (0, 63, 64, 4095, 4096, 4097-in-limb-0)."""
+    pat = np.zeros((pp.NL, B), np.int32)
+    pat[:, 0] = 4096                       # == B everywhere
+    pat[0, 1] = 4097                       # limb 0 may be B+1
+    pat[1:, 1] = 4095
+    pat[:, 2] = 63                         # low-digit-only
+    pat[::2, 3] = 64                       # high-digit-only
+    arr = jnp.asarray(pat)
+    for limbs, width in ((pp.NP_L, pp.NL), (pp.P_L, 2 * pp.NL - 1)):
+        fn = lambda u: pp._conv_const(u, limbs, width)  # noqa: B023
+        want = np.asarray(run_rows(fn, width, arr))
+        got = np.asarray(run_rows_conv(fn, width, "mxu", arr))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_fused_dbl_and_line_matches_separate_ops():
+    """_dbl_and_line must produce byte-identical decoded outputs to the
+    separate point_double2 + _line_dbl it replaces in the Miller loop."""
+    xs = [(rng.randrange(ref.P), rng.randrange(ref.P)) for _ in range(3)]
+    pxv = [rng.randrange(ref.P) for _ in range(B)]
+    pyv = [rng.randrange(ref.P) for _ in range(B)]
+
+    def pack2(vals):
+        return jnp.asarray(np.concatenate(
+            [np.stack([col(vals[0]) for _ in range(B)], axis=1),
+             np.stack([col(vals[1]) for _ in range(B)], axis=1)], axis=0
+        ))
+
+    X, Y, Z = (pack2(v) for v in xs)
+    PX = jnp.asarray(np.stack([col(v) for v in pxv], axis=1))
+    PY = jnp.asarray(np.stack([col(v) for v in pyv], axis=1))
+
+    def unpack(u):
+        return (u[: pp.NL], u[pp.NL :])
+
+    def fused(x, y, z, px, py):
+        t = (unpack(x), unpack(y), unpack(z))
+        (a2, b2, c2), (x3, y3, z3) = pp._dbl_and_line(t, px, py)
+        return jnp.concatenate(
+            [a2[0], a2[1], b2[0], b2[1], c2[0], c2[1],
+             x3[0], x3[1], y3[0], y3[1], z3[0], z3[1]], axis=0
+        )
+
+    def separate(x, y, z, px, py):
+        t = (unpack(x), unpack(y), unpack(z))
+        a2, b2, c2 = pp._line_dbl(t, px, py)
+        x3, y3, z3 = pp.point_double2(t)
+        return jnp.concatenate(
+            [a2[0], a2[1], b2[0], b2[1], c2[0], c2[1],
+             x3[0], x3[1], y3[0], y3[1], z3[0], z3[1]], axis=0
+        )
+
+    got = np.asarray(run_rows(fused, 12 * pp.NL, X, Y, Z, PX, PY))
+    want = np.asarray(run_rows(separate, 12 * pp.NL, X, Y, Z, PX, PY))
+    for r in range(12):
+        for i in range(B):
+            g = decode(got[r * pp.NL : (r + 1) * pp.NL, i])
+            w = decode(want[r * pp.NL : (r + 1) * pp.NL, i])
+            assert g == w, (r, i)
