@@ -1,0 +1,16 @@
+#!/bin/sh
+# On-chip conv-mode/batch ranking for the Pallas verify kernel.
+# Appends one bench.py JSON line per config to bench_matrix.jsonl.
+# Usage: tools/bench_matrix.sh [outfile]
+OUT=${1:-bench_matrix.jsonl}
+run () {
+  desc=$1; shift
+  echo "### $desc" >> "$OUT.log"
+  env "$@" BENCH_PROBE_TIMEOUT=120 timeout 3600 \
+    python bench.py 2>> "$OUT.log" | tail -1 >> "$OUT"
+}
+run "mxu e2e b1024"       DRAND_TPU_PALLAS_CONV=mxu
+run "kara e2e b1024"      DRAND_TPU_PALLAS_CONV=kara
+run "mxu+kara e2e b1024"  DRAND_TPU_PALLAS_CONV=mxu+kara
+run "vpu device-only b1024" BENCH_DEVICE_ONLY=1
+run "vpu e2e b2048"       BENCH_BATCH=2048 BENCH_ITERS=2
