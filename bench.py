@@ -22,7 +22,10 @@ pairings/sec = 2 * end-to-end rounds/sec.
 
 Environment knobs:
   BENCH_BATCH   rounds per device call   (default 1024)
-  BENCH_ITERS   timed iterations         (default 4)
+  BENCH_ITERS   timed iterations per repeat (default 4)
+  BENCH_REPEATS independent timed repeats; value = MEDIAN throughput,
+                min/max reported in detail (default 3 — VERDICT r4
+                weak #2: two same-config on-chip runs differed 27%)
   BENCH_KERNEL  "pallas" (default: the mega-kernel) or "opgraph"
   BENCH_DEVICE_ONLY  "1": skip hashing, time the pairing check alone
   BENCH_PROBE_TIMEOUT  seconds to wait for the ambient JAX backend
@@ -149,6 +152,7 @@ def main() -> None:
 
     batch = int(os.environ.get("BENCH_BATCH", "1024"))
     iters = int(os.environ.get("BENCH_ITERS", "4"))
+    repeats = int(os.environ.get("BENCH_REPEATS", "3"))
     device_only = os.environ.get("BENCH_DEVICE_ONLY", "0") == "1"
 
     # --- build a valid workload ------------------------------------------
@@ -208,19 +212,29 @@ def main() -> None:
         os.environ["DRAND_TPU_PROFILE_DIR"] = profile_dir
     from drand_tpu.utils.profiling import profile_span
 
-    # the span wraps the loop but dt is captured INSIDE it, before
+    # the span wraps the loops but each dt is captured INSIDE it, before
     # stop_trace serializes the trace to disk — profiling must not
     # deflate the recorded throughput
+    times = []
     with profile_span("bench-verify"):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = (verify_e2e(msgs) if not device_only
-                   else verify_device_only(q2_fixed))
-        out.block_until_ready()
-        dt = time.perf_counter() - t0
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = (verify_e2e(msgs) if not device_only
+                       else verify_device_only(q2_fixed))
+            out.block_until_ready()
+            times.append(time.perf_counter() - t0)
 
-    rounds_per_sec = batch * iters / dt
+    per_rep = sorted(batch * iters / dt for dt in times)
+    rounds_per_sec = float(np.median(per_rep))
     pairings_per_sec = 2 * rounds_per_sec
+    # what the kernel actually compiled with, not the env echo
+    # (VERDICT r4 weak #3b); the op-graph path has no conv backend
+    if kernel == "pallas":
+        from drand_tpu.ops import pallas_pairing as _pp
+        conv_used = _pp.LAST_CONV
+    else:
+        conv_used = None
     print(json.dumps({
         "metric": "beacon-chain batch-verify throughput, incl. "
                   "hash-to-curve (BLS12-381 pairings/sec/chip)",
@@ -229,12 +243,15 @@ def main() -> None:
         "vs_baseline": round(pairings_per_sec / 50_000.0, 4),
         "detail": {
             "rounds_per_sec": round(rounds_per_sec, 1),
+            "rounds_per_sec_min": round(per_rep[0], 1),
+            "rounds_per_sec_max": round(per_rep[-1], 1),
             "includes_hash_to_curve": not device_only,
             "batch": batch,
             "kernel": kernel,
-            "conv": os.environ.get("DRAND_TPU_PALLAS_CONV", "vpu"),
+            "conv": conv_used,
             "iters": iters,
-            "seconds": round(dt, 3),
+            "repeats": repeats,
+            "seconds_per_repeat": [round(dt, 3) for dt in times],
             "device": str(jax.devices()[0]),
             "cpu_fallback": os.environ.get("BENCH_FALLBACK") == "1",
             "est_1M_rounds_seconds": round(1_000_000 / rounds_per_sec, 1),

@@ -64,7 +64,13 @@ def _unpack_partial(blob: bytes):
             f"partial must be {INDEX_LEN + SIG_LEN} bytes, got {len(blob)}"
         )
     index = int.from_bytes(blob[:INDEX_LEN], "big")
-    pt = ref.g2_from_bytes(blob[INDEX_LEN:])
+    try:
+        pt = ref.g2_from_bytes(blob[INDEX_LEN:])
+    except ValueError as e:
+        # malformed wire bytes (bad flags / not on curve / wrong subgroup)
+        # are an invalid partial, not an internal error — keep the Scheme
+        # contract: ThresholdError for anything a peer could send us
+        raise ThresholdError(f"malformed partial: {e}") from None
     if pt is None:
         raise ThresholdError("identity signature rejected")
     return index, pt
@@ -148,7 +154,10 @@ class RefScheme(Scheme):
         return ref.g2_to_bytes(acc)
 
     def verify_recovered(self, pub_key, msg: bytes, sig: bytes) -> None:
-        sig_pt = ref.g2_from_bytes(sig)
+        try:
+            sig_pt = ref.g2_from_bytes(sig)
+        except ValueError as e:
+            raise ThresholdError(f"malformed signature: {e}") from None
         if sig_pt is None:
             raise ThresholdError("identity signature rejected")
         h = hash_to_sig_group(msg)

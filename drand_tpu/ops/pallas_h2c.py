@@ -329,8 +329,7 @@ def hash_to_g2(u0, u1, block: int = 128, interpret: bool = False,
                conv: str | None = None):
     """Batched device hash: field draws (B, 2, NL) Montgomery ->
     affine G2 points (B, 2, 2, NL)."""
-    if conv is None:
-        conv = pp.CONV_MODE_DEFAULT
+    conv = pp.resolve_conv(conv)
     (u0, u1), bsz = _pad_batch([u0, u1], block)
     n = u0.shape[0]
     u_all = jnp.concatenate([_rows_fp2(u0), _rows_fp2(u1)], axis=0)
@@ -376,8 +375,7 @@ def pairing_product_check_hashed(p1, q1, p2, u0, u1, block: int = 128,
     p1/p2: (B, 2, NL) affine G1; q1: (B, 2, 2, NL) affine G2;
     u0/u1: (B, 2, NL) hash-to-field draws.  Returns bool (B,).
     """
-    if conv is None:
-        conv = pp.CONV_MODE_DEFAULT
+    conv = pp.resolve_conv(conv)
     (p1, q1, p2, u0, u1), bsz = _pad_batch([p1, q1, p2, u0, u1], block)
     n = p1.shape[0]
 

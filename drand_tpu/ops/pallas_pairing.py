@@ -162,6 +162,21 @@ TOEP_NP_ARR = np.concatenate(
 #: Overridable per call via pairing_product_check(conv=...).
 CONV_MODE_DEFAULT = os.environ.get("DRAND_TPU_PALLAS_CONV", "vpu")
 
+#: the conv mode most recently resolved by a host entry at trace time —
+#: what the kernel ACTUALLY compiled with, as opposed to the env echo
+#: (VERDICT r4 weak #3b: mislabeled-artifact hazard).  Read by bench.py.
+LAST_CONV: str | None = None
+
+
+def resolve_conv(conv: str | None) -> str:
+    """Resolve a per-call conv override against the module default and
+    record it in LAST_CONV for honest artifact labeling."""
+    global LAST_CONV
+    if conv is None:
+        conv = CONV_MODE_DEFAULT
+    LAST_CONV = conv
+    return conv
+
 #: populated at kernel entry: {"consts": (K, NL, 1) array, optional
 #: Toeplitz splits "TNP_hi/lo", "TP_hi/lo" when conv == "mxu"}
 _CTX = {}
@@ -1357,8 +1372,7 @@ def pairing_product_check(p1, q1, p2, q2, block: int = 128,
     conv: constant-conv backend ("vpu"/"mxu"); None = DRAND_TPU_PALLAS_CONV.
     Returns bool (B,).
     """
-    if conv is None:
-        conv = CONV_MODE_DEFAULT
+    conv = resolve_conv(conv)
     bsz = p1.shape[0]
     pad = (-bsz) % block
     if pad:

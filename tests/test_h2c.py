@@ -11,6 +11,10 @@ import pytest
 
 from drand_tpu.crypto import refimpl as ref
 from drand_tpu.ops import curve, h2c, tower
+# Compile-heavy (XLA traces of the full op-graph crypto): slow tier.
+# The per-push CI tier must stay <5 min on a 1-core host (VERDICT r4 next #5).
+pytestmark = pytest.mark.slow
+
 
 B = 4  # batch size shared across tests to bound XLA compiles
 

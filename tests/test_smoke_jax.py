@@ -1,0 +1,47 @@
+"""Fast-tier JAX smoke coverage.
+
+The full op-graph/pallas/mesh suites live in the slow tier (compile cost
+on a 1-core CI host, see pytest.ini); this file keeps a minimal jit +
+virtual-mesh signal in the per-push tier so a broken JAX install or a
+broken limb codec fails fast, not weekly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.ops import fp
+
+
+def test_virtual_mesh_present():
+    # conftest forces 8 virtual CPU devices (driver dryrun parity)
+    assert len(jax.devices()) == 8
+
+
+def test_fp_codec_roundtrip():
+    xs = [1, ref.P - 1, 0xDEADBEEF, ref.P >> 1]
+    enc = fp.encode_batch(xs)
+    dec = [fp.limbs_to_int(row) for row in np.asarray(fp.canon(enc))]
+    assert dec == xs
+
+
+def test_fp_add_jit_smoke():
+    # one tiny jit: add is the cheapest whole-pipeline op (encode ->
+    # lazy-carry limb arithmetic -> decode) that still exercises XLA
+    a, b = 0x1234, ref.P - 7
+    out = jax.jit(fp.add)(fp.fp_encode(a), fp.fp_encode(b))
+    assert fp.fp_decode(np.asarray(out)) == (a + b) % ref.P
+
+
+def test_psum_on_mesh_smoke():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("d",))
+    out = jax.jit(
+        jax.shard_map(
+            lambda x: jax.lax.psum(x, "d"),
+            mesh=mesh,
+            in_specs=jax.sharding.PartitionSpec("d"),
+            out_specs=jax.sharding.PartitionSpec(),
+        )
+    )(jnp.arange(8.0))
+    assert float(out[0]) == 28.0

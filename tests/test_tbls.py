@@ -13,6 +13,11 @@ from drand_tpu.crypto.poly import (
     recover_secret,
 )
 
+# Only the JaxScheme tests are compile-heavy (XLA traces of the full
+# op-graph crypto) — those carry @pytest.mark.slow individually; the
+# pure-Python poly/RefScheme coverage stays in the per-push tier.
+slow = pytest.mark.slow
+
 rng = random.Random(0x7B15)
 MSG = b"drand-tpu round 1 message"
 
@@ -79,10 +84,39 @@ def test_ref_scheme_3_of_5():
     _run_scheme_3_of_5(tbls.RefScheme())
 
 
+def test_malformed_wire_bytes_raise_threshold_error():
+    """Hostile-peer bytes must surface as ThresholdError, never a raw
+    ValueError — daemon/client code catches only ThresholdError on the
+    partial path (core/client.py), so a leak here is a crash on a
+    malicious packet."""
+    poly = fixed_group(2, 48)
+    pub = poly.commit()
+    scheme = tbls.RefScheme()
+    good = scheme.partial_sign(poly.eval(0), MSG)
+    idx = good[:2]
+
+    # flipped last byte: valid flags, x decodes, but off-curve/off-subgroup
+    tampered = good[:-1] + bytes([good[-1] ^ 1])
+    # all-0xFF body: x >= p with the compression flags set
+    junk = idx + b"\xff" * 96
+    # cleared flag bits: compression bit absent entirely
+    noflags = idx + bytes([good[2] & 0x1F]) + good[3:]
+    for blob in (tampered, junk, noflags, b"\x00garbage", b""):
+        with pytest.raises(tbls.ThresholdError):
+            scheme.verify_partial(pub, MSG, blob)
+
+    for sig in (b"\xff" * 96, b"\x00" * 96, b"short",
+                good[2:-1] + bytes([good[-1] ^ 1])):
+        with pytest.raises(tbls.ThresholdError):
+            scheme.verify_recovered(pub.commit(), MSG, sig)
+
+
+@slow
 def test_jax_scheme_3_of_5():
     _run_scheme_3_of_5(tbls.JaxScheme())
 
 
+@slow
 def test_backends_interoperate():
     t, n = 2, 3
     poly = fixed_group(t, 45)
@@ -99,6 +133,7 @@ def test_backends_interoperate():
     b.verify_recovered(pub.commit(), MSG, sig_a)
 
 
+@slow
 def test_jax_batch_partial_verify():
     t, n = 3, 6
     poly = fixed_group(t, 46)
@@ -114,6 +149,7 @@ def test_jax_batch_partial_verify():
     assert got == [True, False, True, False, True, True]
 
 
+@slow
 def test_jax_chain_batch_verify():
     poly = fixed_group(2, 47)
     sk = poly.secret()

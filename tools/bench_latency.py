@@ -1,0 +1,106 @@
+"""Single-round protocol-plane latency: device vs native backend at small
+batch.
+
+The per-round path (1 partial-sign + t-collect + 1 recover, SURVEY §7.10
+hard part #3) lives or dies on SMALL-batch latency, not throughput; the
+reference budgets 300 ms of slack for it (core/constants.go:45).  This
+records verify_partials_batch and recover wall latency at batch {1, 8,
+128} for the JaxScheme (device) and NativeScheme (C++ host) backends so
+the dispatch-threshold choice in `tbls.JaxScheme._bucket` is justified by
+data, not vibes (VERDICT r4 next #7).
+
+Writes one JSON line per (backend, op, batch) to stdout; run with the
+repo root on sys.path:  python tools/bench_latency.py
+Compile/warmup is excluded; each cell reports the median of BENCH_REPEATS
+(default 5) timed calls.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _cells(scheme, name, batches, repeats):
+    from drand_tpu.beacon.chain import beacon_message
+    from drand_tpu.crypto.poly import PriPoly
+
+    for b in batches:
+        # verify runs at exactly batch b; recovery needs t >= 2 partials
+        t, n = max(2, b), max(2, b) + 1
+        poly = PriPoly.random(t, secret=0xA11CE + b)
+        shares = [poly.eval(i) for i in range(n)]
+        pub = poly.commit()
+        msg = beacon_message(b"latency-bench", 6, 7)
+        partials = [scheme.partial_sign(s, msg) for s in shares]
+
+        # warmup both ops (compiles excluded from timing)
+        assert all(scheme.verify_partials_batch(pub, msg, partials[:b]))
+        sig = scheme.recover(pub, msg, partials[:t], t, n)
+        scheme.verify_recovered(pub.commits[0], msg, sig)
+
+        medians = {}
+        for op, fn in (
+            ("sign", lambda: scheme.partial_sign(shares[0], msg)),
+            ("verify_partials",
+             lambda: scheme.verify_partials_batch(pub, msg, partials[:b])),
+            ("recover",
+             lambda: scheme.recover(pub, msg, partials[:t], t, n)),
+        ):
+            times = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                fn()
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            med = float(np.median(times))
+            medians[op] = med
+            yield {
+                "backend": name, "op": op,
+                "batch": b if op == "verify_partials" else
+                         (1 if op == "sign" else t),
+                "median_ms": round(1e3 * med, 2),
+                "min_ms": round(1e3 * times[0], 2),
+                "max_ms": round(1e3 * times[-1], 2),
+                "repeats": repeats,
+            }
+        # the budget applies to the whole per-round path (sign + verify
+        # the flood + recover), not each op in isolation
+        total = sum(medians.values())
+        yield {
+            "backend": name, "op": "round_path", "batch": b,
+            "median_ms": round(1e3 * total, 2),
+            "components": {k: round(1e3 * v, 2) for k, v in medians.items()},
+            "within_300ms_budget": total < 0.300,
+        }
+
+
+def main() -> None:
+    from drand_tpu.crypto import native_bls, tbls
+
+    repeats = int(os.environ.get("BENCH_REPEATS", "5"))
+    batches = [int(x) for x in
+               os.environ.get("BENCH_BATCHES", "1,8,128").split(",")]
+    # batch 1 still needs t >= 2 for a meaningful recovery
+    schemes = []
+    if native_bls.available():
+        schemes.append((tbls.NativeScheme(), "native-cpp"))
+    schemes.append((tbls.JaxScheme(), "jax"))
+    rows = []
+    for scheme, name in schemes:
+        for row in _cells(scheme, name, batches, repeats):
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    out = os.environ.get("BENCH_LATENCY_OUT")
+    if out:
+        with open(out, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,16 +1,42 @@
 #!/bin/sh
 # On-chip conv-mode/batch ranking for the Pallas verify kernel.
-# Appends one bench.py JSON line per config to bench_matrix.jsonl.
+# Writes one JSON line per config to bench_matrix.jsonl, each tagged
+# with {"cfg": ...}; a config that fails still emits a line with
+# {"cfg": ..., "failed": true, "rc": N} so rows never misalign with
+# configs (ADVICE r4).  Output files are truncated at start so reruns
+# never mix stale results.
 # Usage: tools/bench_matrix.sh [outfile]
 OUT=${1:-bench_matrix.jsonl}
+: > "$OUT"
+: > "$OUT.log"
+TMP=$(mktemp)
+trap 'rm -f "$TMP"' EXIT
 run () {
   desc=$1; shift
-  echo "### $desc" >> "$OUT.log"
-  env "$@" BENCH_PROBE_TIMEOUT=120 timeout 3600 \
-    python bench.py 2>> "$OUT.log" | tail -1 >> "$OUT"
+  echo "### $desc ($(date -u +%H:%M:%S))" >> "$OUT.log"
+  env "$@" BENCH_PROBE_TIMEOUT=120 timeout 1800 \
+    python bench.py > "$TMP" 2>> "$OUT.log"
+  rc=$?
+  line=$(tail -1 "$TMP")
+  CFG="$desc" LINE="$line" RC="$rc" python - >> "$OUT" <<'EOF'
+import json, os
+cfg, line, rc = os.environ["CFG"], os.environ["LINE"], int(os.environ["RC"])
+try:
+    rec = json.loads(line)
+    if not isinstance(rec, dict):
+        raise ValueError
+except Exception:
+    rec = {"failed": True, "rc": rc, "raw": line[:200]}
+if rc != 0:
+    rec.setdefault("failed", True)
+    rec["rc"] = rc
+print(json.dumps({"cfg": cfg, **rec}))
+EOF
 }
-run "mxu e2e b1024"       DRAND_TPU_PALLAS_CONV=mxu
-run "kara e2e b1024"      DRAND_TPU_PALLAS_CONV=kara
-run "mxu+kara e2e b1024"  DRAND_TPU_PALLAS_CONV=mxu+kara
-run "vpu device-only b1024" BENCH_DEVICE_ONLY=1
-run "vpu e2e b2048"       BENCH_BATCH=2048 BENCH_ITERS=2
+run "vpu e2e b1024"         DRAND_TPU_PALLAS_CONV=vpu
+run "mxu e2e b1024"         DRAND_TPU_PALLAS_CONV=mxu
+run "kara e2e b1024"        DRAND_TPU_PALLAS_CONV=kara
+run "mxu+kara e2e b1024"    DRAND_TPU_PALLAS_CONV=mxu+kara
+run "vpu device-only b1024" DRAND_TPU_PALLAS_CONV=vpu BENCH_DEVICE_ONLY=1
+run "vpu e2e b2048"         DRAND_TPU_PALLAS_CONV=vpu BENCH_BATCH=2048 BENCH_ITERS=2
+run "vpu e2e b4096"         DRAND_TPU_PALLAS_CONV=vpu BENCH_BATCH=4096 BENCH_ITERS=2
