@@ -279,14 +279,15 @@ def _hash_kernel(consts_ref, toep_ref, u_ref, out_ref, *,
 
 
 def _check_hashed_kernel(consts_ref, toep_ref, p_ref, q_ref, u_ref,
-                         out_ref, *, conv: str = "vpu"):
+                         out_ref, *, conv: str = "vpu",
+                         miller: str = "split"):
     """End-to-end verify: Q2 = H(m) in-kernel, then the product check.
 
     p_ref: (4*NL, B) G1 rows [p1.x|p1.y|p2.x|p2.y]
     q_ref: (4*NL, B) G2 rows of Q1 (the signature)
     u_ref: (4*NL, B) hash-to-field draws of the message
     """
-    pp._set_ctx(consts_ref, toep_ref, conv)
+    pp._set_ctx(consts_ref, toep_ref, conv, miller)
     b = p_ref.shape[-1]
     q2 = _hash_point(_u_tuple(u_ref, 0), _u_tuple(u_ref, 1))
     ok = pp._product_check(
@@ -366,16 +367,20 @@ def hash_to_g2(u0, u1, block: int = 128, interpret: bool = False,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block", "interpret", "conv"))
+                   static_argnames=("block", "interpret", "conv",
+                                    "miller"))
 def pairing_product_check_hashed(p1, q1, p2, u0, u1, block: int = 128,
                                  interpret: bool = False,
-                                 conv: str | None = None):
+                                 conv: str | None = None,
+                                 miller: str | None = None):
     """e(P1, Q1) · e(P2, H(u)) == 1 with the hash computed in-kernel.
 
     p1/p2: (B, 2, NL) affine G1; q1: (B, 2, 2, NL) affine G2;
     u0/u1: (B, 2, NL) hash-to-field draws.  Returns bool (B,).
+    miller: "shared"/"split" Miller strategy; None = DRAND_TPU_MILLER.
     """
     conv = pp.resolve_conv(conv)
+    miller = pp.resolve_miller(miller)
     (p1, q1, p2, u0, u1), bsz = _pad_batch([p1, q1, p2, u0, u1], block)
     n = p1.shape[0]
 
@@ -391,7 +396,7 @@ def pairing_product_check_hashed(p1, q1, p2, u0, u1, block: int = 128,
 
     nconst = pp.CONSTS_NP.shape[0]
     out = pl.pallas_call(
-        functools.partial(_check_hashed_kernel, conv=conv),
+        functools.partial(_check_hashed_kernel, conv=conv, miller=miller),
         out_shape=jax.ShapeDtypeStruct((8, n), jnp.int32),
         grid=(n // block,),
         in_specs=[

@@ -162,10 +162,19 @@ TOEP_NP_ARR = np.concatenate(
 #: Overridable per call via pairing_product_check(conv=...).
 CONV_MODE_DEFAULT = os.environ.get("DRAND_TPU_PALLAS_CONV", "vpu")
 
-#: the conv mode most recently resolved by a host entry at trace time —
-#: what the kernel ACTUALLY compiled with, as opposed to the env echo
-#: (VERDICT r4 weak #3b: mislabeled-artifact hazard).  Read by bench.py.
+#: Miller-loop strategy for the pairing-product check: "shared" fuses
+#: both Miller loops into ONE square-and-multiply pass with a shared
+#: fp12 accumulator (f = f^2 * l1 * l2 — one fp12 squaring per doubling
+#: bit instead of two; standard multi-pairing batching), "split" runs
+#: the two loops sequentially and multiplies the results.
+MILLER_MODE_DEFAULT = os.environ.get("DRAND_TPU_MILLER", "split")
+
+#: the conv/miller modes most recently resolved by a host entry at trace
+#: time — what the kernel ACTUALLY compiled with, as opposed to the env
+#: echo (VERDICT r4 weak #3b: mislabeled-artifact hazard).  Read by
+#: bench.py.
 LAST_CONV: str | None = None
+LAST_MILLER: str | None = None
 
 
 def resolve_conv(conv: str | None) -> str:
@@ -177,20 +186,34 @@ def resolve_conv(conv: str | None) -> str:
     LAST_CONV = conv
     return conv
 
+
+def resolve_miller(miller: str | None) -> str:
+    """Same for the Miller-loop strategy (shared/split)."""
+    global LAST_MILLER
+    if miller is None:
+        miller = MILLER_MODE_DEFAULT
+    if miller not in ("shared", "split"):
+        raise ValueError(f"unknown miller mode: {miller!r}")
+    LAST_MILLER = miller
+    return miller
+
 #: populated at kernel entry: {"consts": (K, NL, 1) array, optional
 #: Toeplitz splits "TNP_hi/lo", "TP_hi/lo" when conv == "mxu"}
 _CTX = {}
 
 
-def _set_ctx(consts_ref, toep_ref, conv: str) -> None:
+def _set_ctx(consts_ref, toep_ref, conv: str,
+             miller: str = "split") -> None:
     """Populate the in-kernel context (single-threaded tracing).
 
     `conv` is a mode string: "mxu" routes the constant REDC convolutions
     to the systolic array, "kara" splits the data convolution 17/17
     Karatsuba-style (25% fewer multiply rows); "mxu+kara" combines both.
+    `miller` picks the product-check loop strategy (shared/split).
     """
     _CTX["consts"] = consts_ref[:]
     _CTX["conv"] = conv
+    _CTX["miller"] = miller
     if "mxu" in conv:
         t = toep_ref[:]
         for name, m in (("TNP", t[:NL]), ("TP", t[NL:])):
@@ -1285,6 +1308,56 @@ def _miller(px, py, xq, yq, b):
     return fp12_conj(state[0])  # x < 0
 
 
+def _miller_pair(p1x, p1y, q1, p2x, p2y, q2, b):
+    """Both Miller loops fused into ONE square-and-multiply pass over the
+    shared |x| bit pattern, with a single fp12 accumulator:
+    f = f^2 * l1 * l2 per doubling bit costs one fp12 squaring where the
+    split loops pay two (standard multi-pairing batching).  Carries two
+    twist points through the segment scan (24 stacked fp2 rows vs 18)."""
+
+    def dbl_step(state):
+        f, t1, t2 = state
+        (a2, bb2, c2), t1 = _dbl_and_line(t1, p1x, p1y)
+        (d2, e2, g2), t2 = _dbl_and_line(t2, p2x, p2y)
+        f = fp12_mul_by_line_lazy(fp12_sqr_lazy(f), a2, bb2, c2)
+        f = fp12_mul_by_line_lazy(f, d2, e2, g2)
+        return f, t1, t2
+
+    def add_step(state):
+        f, t1, t2 = state
+        a2, bb2, c2 = _line_add(t1, q1[0], q1[1], p1x, p1y)
+        t1 = point_add2(t1, (q1[0], q1[1], fp2_one(b)))
+        d2, e2, g2 = _line_add(t2, q2[0], q2[1], p2x, p2y)
+        t2 = point_add2(t2, (q2[0], q2[1], fp2_one(b)))
+        f = fp12_mul_by_line_lazy(f, a2, bb2, c2)
+        f = fp12_mul_by_line_lazy(f, d2, e2, g2)
+        return f, t1, t2
+
+    def to_stack(state):
+        f, t1, t2 = state
+        return jnp.concatenate(
+            [_fp12_to_stack(f), _t_to_stack(t1), _t_to_stack(t2)], axis=0
+        )
+
+    def from_stack(s):
+        return (_stack_to_fp12(s[:12]), _stack_to_t(s[12:18]),
+                _stack_to_t(s[18:24]))
+
+    state = (
+        fp12_one(b),
+        (q1[0], q1[1], fp2_one(b)),
+        (q2[0], q2[1], fp2_one(b)),
+    )
+    state = _segment_scan(
+        state, MILLER_BITS,
+        sqr_step=dbl_step,
+        mul_step=lambda s: add_step(dbl_step(s)),
+        to_stack=to_stack,
+        from_stack=from_stack,
+    )
+    return fp12_conj(state[0])  # x < 0
+
+
 def _product_check(p1x, p1y, q1, p2x, p2y, q2, b):
     """Core check e(P1,Q1)·e(P2,Q2)==1 on in-kernel values.
 
@@ -1292,9 +1365,12 @@ def _product_check(p1x, p1y, q1, p2x, p2y, q2, b):
     bool verdict row.  Shared by the plain kernel and the hashed-input
     kernel (pallas_h2c.py), which computes Q2 = H(m) in-kernel first.
     """
-    f1 = _miller(p1x, p1y, q1[0], q1[1], b)
-    f2 = _miller(p2x, p2y, q2[0], q2[1], b)
-    g = fp12_mul_lazy(f1, f2)
+    if _CTX.get("miller", "split") == "shared":
+        g = _miller_pair(p1x, p1y, q1, p2x, p2y, q2, b)
+    else:
+        f1 = _miller(p1x, p1y, q1[0], q1[1], b)
+        f2 = _miller(p2x, p2y, q2[0], q2[1], b)
+        g = fp12_mul_lazy(f1, f2)
 
     # final exponentiation (cubed; see ops/pairing.py)
     t0 = fp12_mul_lazy(fp12_conj(g), fp12_inv(g))
@@ -1325,7 +1401,7 @@ def _product_check(p1x, p1y, q1, p2x, p2y, q2, b):
 
 
 def _check_kernel(consts_ref, toep_ref, p_ref, q_ref, out_ref, *,
-                  conv: str = "vpu"):
+                  conv: str = "vpu", miller: str = "split"):
     """Batched product check over one block.
 
     consts_ref: (K, NL, 1) VMEM — limb constants (leading-dim indexed)
@@ -1336,10 +1412,12 @@ def _check_kernel(consts_ref, toep_ref, p_ref, q_ref, out_ref, *,
     out_ref: (8, B) int32 — row 0 holds the verdict (padded to the int32
                          min sublane tile).
 
-    The two Miller loops run sequentially on single-width batches —
-    doubling lanes and splitting mid-kernel trips Mosaic layout bugs.
+    miller="split" runs the two Miller loops sequentially on
+    single-width batches (doubling lanes mid-kernel trips Mosaic layout
+    bugs); "shared" fuses them into one pass with a shared accumulator —
+    same width, just more carried state.
     """
-    _set_ctx(consts_ref, toep_ref, conv)
+    _set_ctx(consts_ref, toep_ref, conv, miller)
 
     b = p_ref.shape[-1]
     ok = _product_check(
@@ -1361,18 +1439,22 @@ def _check_kernel(consts_ref, toep_ref, p_ref, q_ref, out_ref, *,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block", "interpret", "conv"))
+                   static_argnames=("block", "interpret", "conv",
+                                    "miller"))
 def pairing_product_check(p1, q1, p2, q2, block: int = 128,
                           interpret: bool = False,
-                          conv: str | None = None):
+                          conv: str | None = None,
+                          miller: str | None = None):
     """Batched e(P1,Q1)*e(P2,Q2)==1 via the Pallas mega-kernel.
 
     Inputs use the op-graph layout (batch-first, limbs-last):
       p*: (B, 2, NL)  affine G1,  q*: (B, 2, 2, NL) affine G2 (Montgomery)
     conv: constant-conv backend ("vpu"/"mxu"); None = DRAND_TPU_PALLAS_CONV.
+    miller: "shared"/"split" loop strategy; None = DRAND_TPU_MILLER.
     Returns bool (B,).
     """
     conv = resolve_conv(conv)
+    miller = resolve_miller(miller)
     bsz = p1.shape[0]
     pad = (-bsz) % block
     if pad:
@@ -1397,7 +1479,7 @@ def pairing_product_check(p1, q1, p2, q2, block: int = 128,
 
     nconst = CONSTS_NP.shape[0]
     out = pl.pallas_call(
-        functools.partial(_check_kernel, conv=conv),
+        functools.partial(_check_kernel, conv=conv, miller=miller),
         out_shape=jax.ShapeDtypeStruct((8, n), jnp.int32),
         grid=(grid,),
         in_specs=[
