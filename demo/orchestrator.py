@@ -18,7 +18,7 @@ import socket
 import subprocess
 import sys
 import time
-import tomllib
+from drand_tpu.utils import tomlcompat as tomllib
 from pathlib import Path
 from typing import Dict, List, Optional
 

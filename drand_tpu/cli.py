@@ -9,6 +9,8 @@ and daemon.go/control.go/public.go):
   drand-tpu start                          run the daemon
   drand-tpu warmup                         pre-compile device kernels into
                                            the persistent XLA cache
+  drand-tpu verify-serve --distkey <hex>   standalone dynamic-batching
+                                           verification gateway
   drand-tpu stop                           stop via the control port
   drand-tpu share <group.toml> [--leader]  run the DKG (or reshare with
                                            --from-group)
@@ -28,7 +30,7 @@ import os
 import shutil
 import sys
 import time
-import tomllib
+from drand_tpu.utils import tomlcompat as tomllib
 from pathlib import Path
 
 from drand_tpu.key import (
@@ -255,6 +257,62 @@ def cmd_warmup(args) -> int:
     return 0
 
 
+def cmd_verify_serve(args) -> int:
+    """Standalone verification gateway: no daemon, no group membership —
+    just the distributed key, the batching kernel and an HTTP front end
+    (POST /v1/verify + /metrics).  The serving analogue of `get public`:
+    anyone holding the collective key can offer verification-as-a-
+    service for the chain."""
+    import signal
+
+    from drand_tpu.crypto import refimpl as ref
+    from drand_tpu.crypto import tbls
+    from drand_tpu.net.rest import build_verify_app, start_rest
+    from drand_tpu.serve import VerifyGateway
+
+    try:
+        # schemes take the collective key as a decoded G1 point (the
+        # same shape DistPublic.key() hands the daemon), not wire bytes
+        dist_key = ref.g1_from_bytes(bytes.fromhex(args.distkey))
+    except ValueError as e:
+        print(f"bad --distkey: {e}", file=sys.stderr)
+        return 1
+    if dist_key is None:
+        print("bad --distkey: identity point", file=sys.stderr)
+        return 1
+
+    async def run() -> int:
+        gateway = VerifyGateway(
+            dist_key,
+            tbls.default_scheme(args.backend),
+            max_batch=args.max_batch,
+            max_wait=args.max_wait,
+            max_queue=args.max_queue,
+            cache_size=args.cache_size,
+        )
+        await gateway.start()
+        runner, port = await start_rest(
+            build_verify_app(gateway), args.port
+        )
+        print(f"verify gateway on :{port} "
+              f"(max_batch={args.max_batch}, max_wait={args.max_wait}s, "
+              f"queue={args.max_queue}, "
+              f"backend={type(gateway.scheme).__name__})", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        await stop.wait()
+        await runner.cleanup()
+        await gateway.close()
+        return 0
+
+    return asyncio.run(run())
+
+
 def _control(args):
     from drand_tpu.net import ControlClient
 
@@ -473,6 +531,29 @@ def build_parser() -> argparse.ArgumentParser:
              "(repeatable; default 2 and 3)",
     )
     g.set_defaults(fn=cmd_warmup)
+
+    g = sub.add_parser(
+        "verify-serve",
+        help="standalone dynamic-batching verification gateway "
+             "(POST /v1/verify)",
+    )
+    g.add_argument("--distkey", required=True,
+                   help="48-byte compressed collective G1 key (hex)")
+    g.add_argument("--port", type=int, default=8080)
+    g.add_argument("--max-batch", type=int, default=128,
+                   help="requests per kernel batch (one Pallas block)")
+    g.add_argument("--max-wait", type=float, default=0.005,
+                   help="seconds to hold a partial batch before flushing")
+    g.add_argument("--max-queue", type=int, default=1024,
+                   help="admission bound; beyond it requests get HTTP 429")
+    g.add_argument("--cache-size", type=int, default=4096,
+                   help="verified-round LRU entries")
+    g.add_argument(
+        "--backend", choices=["auto", "ref", "jax", "native"],
+        default=os.environ.get("DRAND_TPU_BACKEND", "auto"),
+        help="crypto backend (same semantics as `start --backend`)",
+    )
+    g.set_defaults(fn=cmd_verify_serve)
 
     g = sub.add_parser("stop")
     g.set_defaults(fn=cmd_stop)

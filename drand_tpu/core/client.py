@@ -84,6 +84,52 @@ class DrandClient:
     async def group(self, peer: Identity) -> str:
         return await self._net.group(peer)
 
+    # -- remote verification (serve/ gateway on the peer) ------------------
+
+    async def verify_remote(self, peer: Identity, b: Beacon,
+                            timeout: Optional[float] = None) -> bool:
+        """Offload one chain-link verification to the peer's batching
+        gateway (VerifyBeacon RPC).  Trust model is the opposite of
+        `public()`: the PEER's TPU does the pairing, so only use it
+        against nodes you already trust or for load-shedding hints.
+        Raises FetchError on shed/timeout (the peer rejects explicitly
+        rather than serving late)."""
+        import grpc
+
+        try:
+            resp = await self._net.verify_beacon(
+                peer, round=b.round, prev_round=b.prev_round,
+                prev_sig=b.prev_sig, signature=b.signature,
+                timeout=timeout,
+            )
+        except grpc.aio.AioRpcError as exc:
+            raise FetchError(
+                f"VerifyBeacon: {exc.code().name}: {exc.details()}"
+            ) from exc
+        return resp.valid
+
+    async def verify_remote_batch(self, peer: Identity, beacons,
+                                  timeout: Optional[float] = None
+                                  ) -> list:
+        """Batch variant: list of Optional[bool] in order (None where
+        the gateway shed that item)."""
+        import grpc
+
+        items = [
+            {"round": b.round, "prev_round": b.prev_round,
+             "prev_sig": b.prev_sig, "signature": b.signature}
+            for b in beacons
+        ]
+        try:
+            resp = await self._net.verify_beacon_batch(
+                peer, items, timeout=timeout
+            )
+        except grpc.aio.AioRpcError as exc:
+            raise FetchError(
+                f"VerifyBeaconBatch: {exc.code().name}: {exc.details()}"
+            ) from exc
+        return [None if r.error else r.valid for r in resp]
+
 
 class RestClient:
     """Verifying client over the JSON REST gateway.
@@ -174,3 +220,51 @@ class RestClient:
     async def distkey(self) -> list:
         j = await self._get_json("/api/info/distkey")
         return j["coefficients"]
+
+    # -- remote verification (POST /v1/verify) -----------------------------
+
+    @staticmethod
+    def _claim_json(b: Beacon) -> dict:
+        return {"round": b.round, "previous_round": b.prev_round,
+                "previous": b.prev_sig.hex(),
+                "signature": b.signature.hex()}
+
+    async def verify_remote(self, b: Beacon,
+                            timeout: Optional[float] = None) -> bool:
+        """Offload one verification to the node's batching gateway.
+        429/504 (explicit shed) surface as FetchError — retryable."""
+        body = self._claim_json(b)
+        if timeout is not None:
+            body["timeout"] = timeout
+        http = await self._http()
+        async with http.post(f"{self.base_url}/v1/verify", json=body,
+                             ssl=self._ssl) as resp:
+            if resp.status != 200:
+                raise FetchError(
+                    f"POST /v1/verify: HTTP {resp.status}: "
+                    f"{await resp.text()}"
+                )
+            j = await resp.json()
+        return bool(j["valid"])
+
+    async def verify_remote_batch(self, beacons,
+                                  timeout: Optional[float] = None
+                                  ) -> list:
+        """Batch variant: list of Optional[bool] in order (None where
+        the gateway shed that item)."""
+        body = {"items": [self._claim_json(b) for b in beacons]}
+        if timeout is not None:
+            body["timeout"] = timeout
+        http = await self._http()
+        async with http.post(f"{self.base_url}/v1/verify", json=body,
+                             ssl=self._ssl) as resp:
+            if resp.status != 200:
+                raise FetchError(
+                    f"POST /v1/verify: HTTP {resp.status}: "
+                    f"{await resp.text()}"
+                )
+            j = await resp.json()
+        return [
+            None if "error" in item else bool(item["valid"])
+            for item in j["items"]
+        ]

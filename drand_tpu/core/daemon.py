@@ -87,6 +87,12 @@ class Config:
     dkg_timeout: float = DEFAULT_DKG_TIMEOUT
     insecure: bool = True                # no TLS (tests / local demos)
     in_memory: bool = False              # MemStore + in-memory beacon db
+    # verification gateway (serve/): batch/backpressure policy for the
+    # VerifyBeacon RPCs and POST /v1/verify
+    verify_max_batch: int = 128          # one Pallas block per tick
+    verify_max_wait: float = 0.005       # flush latency bound (s)
+    verify_max_queue: int = 1024         # admission bound, then shed
+    verify_cache_size: int = 4096        # LRU verified-round entries
 
 
 class Drand:
@@ -111,6 +117,7 @@ class Drand:
         self.dkg: Optional[DKGHandler] = None
         self._dkg_group: Optional[Group] = None
         self._client = GrpcClient(cfg.cert_manager)
+        self._verify_gateway = None
         self._servers: List = []
         self._subscribers: Set[asyncio.Queue] = set()
         self._exit = asyncio.Event()
@@ -232,9 +239,39 @@ class Drand:
                 shutil.rmtree(tmpdir, ignore_errors=True)
         return ctx
 
+    async def verify_gateway(self):
+        """The lazily-started verification gateway (serve/).  Raises
+        RuntimeError until the node knows the distributed key — there is
+        nothing to verify against before the DKG finishes."""
+        if self._verify_gateway is None:
+            dist = self.dist
+            if dist is None:
+                try:
+                    dist = self.key_store.load_dist_public()
+                except Exception:
+                    dist = None
+            if dist is None:
+                raise RuntimeError(
+                    "no distributed key yet (run the DKG first)"
+                )
+            from drand_tpu.serve import VerifyGateway
+
+            self._verify_gateway = VerifyGateway(
+                dist.key(), self.scheme,
+                max_batch=self.cfg.verify_max_batch,
+                max_wait=self.cfg.verify_max_wait,
+                max_queue=self.cfg.verify_max_queue,
+                cache_size=self.cfg.verify_cache_size,
+            )
+            await self._verify_gateway.start()
+        return self._verify_gateway
+
     async def stop(self) -> None:
         if self.beacon is not None:
             await self.beacon.stop()
+        if self._verify_gateway is not None:
+            await self._verify_gateway.close()
+            self._verify_gateway = None
         for s in self._servers:
             if hasattr(s, "stop"):
                 await s.stop(grace=0.1)
@@ -274,7 +311,7 @@ class Drand:
                        entropy: Optional[bytes] = None) -> str:
         """Control-plane fresh DKG (reference InitDKG
         core/drand_control.go:27-85)."""
-        import tomllib
+        from drand_tpu.utils import tomlcompat as tomllib
 
         group = Group.from_dict(tomllib.loads(group_toml))
         self._check_group(group)
@@ -325,7 +362,7 @@ class Drand:
         """Control-plane resharing (reference InitReshare
         core/drand_control.go:91-205): same collective key and chain, new
         membership/threshold, beacon handover at the transition round."""
-        import tomllib
+        from drand_tpu.utils import tomlcompat as tomllib
 
         if old_group_toml:
             old_group = Group.from_dict(tomllib.loads(old_group_toml))

@@ -10,11 +10,14 @@ ciphertext+tag.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import os
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives import hashes
+try:  # preferred AEAD; absent on minimal containers
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ModuleNotFoundError:
+    AESGCM = None
 
 from drand_tpu.crypto import refimpl as ref
 from drand_tpu.crypto.poly import rand_scalar
@@ -27,13 +30,75 @@ class EciesError(Exception):
     pass
 
 
+class _StdlibAEAD:
+    """Fallback AEAD when `cryptography` is unavailable: SHA-256 counter
+    keystream + truncated HMAC-SHA256 tag (encrypt-then-MAC over
+    nonce || aad || ciphertext).  Same call shape as AESGCM but NOT
+    wire-compatible with it — both peers must run the same fallback, so
+    it only suits single-toolchain deployments like this container.
+    """
+
+    TAG_LEN = 16
+
+    def __init__(self, key: bytes):
+        self._enc_key = hashlib.sha256(b"enc" + key).digest()
+        self._mac_key = hashlib.sha256(b"mac" + key).digest()
+
+    def _keystream(self, nonce: bytes, n: int) -> bytes:
+        out = b""
+        ctr = 0
+        while len(out) < n:
+            out += hashlib.sha256(
+                self._enc_key + nonce + ctr.to_bytes(4, "big")
+            ).digest()
+            ctr += 1
+        return out[:n]
+
+    def _tag(self, nonce: bytes, aad: bytes, ct: bytes) -> bytes:
+        mac = hmac.new(self._mac_key, digestmod=hashlib.sha256)
+        for part in (nonce, aad, ct):
+            mac.update(len(part).to_bytes(8, "big"))
+            mac.update(part)
+        return mac.digest()[: self.TAG_LEN]
+
+    def encrypt(self, nonce: bytes, data: bytes, aad) -> bytes:
+        ks = self._keystream(nonce, len(data))
+        ct = bytes(a ^ b for a, b in zip(data, ks))
+        return ct + self._tag(nonce, aad or b"", ct)
+
+    def decrypt(self, nonce: bytes, blob: bytes, aad) -> bytes:
+        if len(blob) < self.TAG_LEN:
+            raise EciesError("ciphertext too short")
+        ct, tag = blob[: -self.TAG_LEN], blob[-self.TAG_LEN :]
+        if not hmac.compare_digest(self._tag(nonce, aad or b"", ct), tag):
+            raise EciesError("authentication failed")
+        ks = self._keystream(nonce, len(ct))
+        return bytes(a ^ b for a, b in zip(ct, ks))
+
+
+_AEAD = AESGCM if AESGCM is not None else _StdlibAEAD
+
+
+def _hkdf_sha256(ikm: bytes, length: int, info: bytes) -> bytes:
+    """RFC 5869 HKDF-SHA256 (salt = zeros) via stdlib hmac — bit-exact
+    with the cryptography package's HKDF this module used before."""
+    prk = hmac.new(b"\x00" * 32, ikm, hashlib.sha256).digest()
+    okm = b""
+    block = b""
+    counter = 1
+    while len(okm) < length:
+        block = hmac.new(
+            prk, block + info + bytes([counter]), hashlib.sha256
+        ).digest()
+        okm += block
+        counter += 1
+    return okm[:length]
+
+
 def _derive_key(shared_point) -> bytes:
-    return HKDF(
-        algorithm=hashes.SHA256(),
-        length=KEY_LEN,
-        salt=None,
-        info=b"drand-tpu-ecies-v1",
-    ).derive(ref.g1_to_bytes(shared_point))
+    return _hkdf_sha256(
+        ref.g1_to_bytes(shared_point), KEY_LEN, b"drand-tpu-ecies-v1"
+    )
 
 
 def encrypt(recipient_pub, plaintext: bytes,
@@ -44,7 +109,7 @@ def encrypt(recipient_pub, plaintext: bytes,
     shared = ref.g1_mul(recipient_pub, eph)
     key = _derive_key(shared)
     nonce = os.urandom(NONCE_LEN)
-    ct = AESGCM(key).encrypt(nonce, plaintext, associated_data or None)
+    ct = _AEAD(key).encrypt(nonce, plaintext, associated_data or None)
     return ref.g1_to_bytes(r_point) + nonce + ct
 
 
@@ -64,6 +129,6 @@ def decrypt(private_scalar: int, blob: bytes,
     shared = ref.g1_mul(r_point, private_scalar)
     key = _derive_key(shared)
     try:
-        return AESGCM(key).decrypt(nonce, ct, associated_data or None)
+        return _AEAD(key).decrypt(nonce, ct, associated_data or None)
     except Exception as exc:
         raise EciesError("decryption failed") from exc

@@ -10,7 +10,7 @@ test key store (/root/reference/test/key_store.go).
 from __future__ import annotations
 
 import os
-import tomllib
+from drand_tpu.utils import tomlcompat as tomllib
 from pathlib import Path
 from typing import Optional
 

@@ -8,6 +8,10 @@ Mirrors the reference's grpc-gateway with hex-JSON marshalling
   POST /api/private           ECIES private randomness
   GET  /api/info/group        group TOML
   GET  /api/info/distkey      collective key coefficients
+  POST /v1/verify             batched beacon verification through the
+                              serve/ gateway (single claim or
+                              {"items": [...]}; 429 on shed, 504 on
+                              deadline — never silent queueing)
   GET  /metrics               Prometheus metrics (beyond the reference,
                               which has no observability endpoints)
   GET  /                      home/status
@@ -62,6 +66,110 @@ load();setInterval(()=>{if(!document.getElementById('r').value)load()},2000);
 """
 
 
+def _parse_verify_claim(j: dict):
+    from drand_tpu.serve import VerifyRequest
+
+    try:
+        # "previous_signature" matches the gRPC VerifyBeaconRequest field;
+        # "previous" is accepted as the short REST-ism
+        prev = j.get("previous_signature", j.get("previous", ""))
+        return VerifyRequest(
+            round=int(j["round"]),
+            prev_round=int(j.get("previous_round", 0)),
+            prev_sig=bytes.fromhex(prev),
+            signature=bytes.fromhex(j["signature"]),
+        )
+    except (KeyError, TypeError, ValueError) as exc:
+        raise web.HTTPBadRequest(
+            text=f"bad verify claim: {exc!r}"
+        ) from None
+
+
+def _verify_result_json(res) -> dict:
+    return {"valid": res.valid, "cached": res.cached,
+            "batch_size": res.batch_size}
+
+
+async def handle_verify(gateway, request):
+    """POST /v1/verify body: one claim {round, previous_round, previous,
+    signature[, timeout]} -> {valid, cached, batch_size}; or
+    {"items": [claim, ...][, timeout]} -> {"items": [...]} where a shed/
+    expired item carries {"error": ...} instead of a verdict.  Explicit
+    backpressure: HTTP 429 when the queue sheds, 504 when the deadline
+    passes — a claim is never silently served late."""
+    from drand_tpu import serve
+
+    try:
+        body = await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="body must be JSON")
+    if not isinstance(body, dict):
+        raise web.HTTPBadRequest(text="body must be a JSON object")
+    timeout = body.get("timeout")
+    if timeout is not None:
+        try:
+            timeout = float(timeout)
+        except (TypeError, ValueError):
+            raise web.HTTPBadRequest(text="timeout must be a number")
+
+    if "items" in body:
+        reqs = [_parse_verify_claim(j) for j in body["items"]]
+        results = await gateway.verify_many(reqs, timeout)
+        items = []
+        for res in results:
+            if isinstance(res, serve.Overloaded):
+                items.append({"error": "overloaded"})
+            elif isinstance(res, serve.DeadlineExceeded):
+                items.append({"error": "deadline exceeded"})
+            elif isinstance(res, BaseException):
+                raise res
+            else:
+                items.append(_verify_result_json(res))
+        return web.json_response({"items": items})
+
+    req = _parse_verify_claim(body)
+    try:
+        res = await gateway.verify(req, timeout)
+    except serve.Overloaded as exc:
+        raise web.HTTPTooManyRequests(
+            text=str(exc), headers={"Retry-After": "1"}
+        )
+    except serve.DeadlineExceeded as exc:
+        raise web.HTTPGatewayTimeout(text=str(exc))
+    except serve.GatewayClosed as exc:
+        raise web.HTTPServiceUnavailable(text=str(exc))
+    return web.json_response(_verify_result_json(res))
+
+
+def build_verify_app(gateway) -> web.Application:
+    """Standalone verification-gateway app (`cli.py verify-serve`): just
+    /v1/verify, /metrics and a status page — no daemon behind it."""
+    routes = web.RouteTableDef()
+
+    @routes.get("/")
+    async def home(request):
+        return web.json_response({
+            "status": "verify gateway",
+            "backend": type(gateway.scheme).__name__,
+            "cache_entries": len(gateway.cache),
+        })
+
+    @routes.post("/v1/verify")
+    async def verify(request):
+        return await handle_verify(gateway, request)
+
+    @routes.get("/metrics")
+    async def metrics_endpoint(request):
+        from drand_tpu.utils import metrics
+
+        return web.Response(text=metrics.render(),
+                            content_type="text/plain", charset="utf-8")
+
+    app = web.Application()
+    app.add_routes(routes)
+    return app
+
+
 def build_rest_app(daemon) -> web.Application:
     routes = web.RouteTableDef()
 
@@ -114,6 +222,14 @@ def build_rest_app(daemon) -> web.Application:
         if toml is None:
             raise web.HTTPNotFound(text="no group configured")
         return web.Response(text=toml, content_type="application/toml")
+
+    @routes.post("/v1/verify")
+    async def verify(request):
+        try:
+            gateway = await daemon.verify_gateway()
+        except RuntimeError as exc:
+            raise web.HTTPServiceUnavailable(text=str(exc))
+        return await handle_verify(gateway, request)
 
     @routes.get("/metrics")
     async def metrics_endpoint(request):

@@ -13,10 +13,13 @@ import ipaddress
 from pathlib import Path
 from typing import List, Optional, Tuple
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+try:  # optional dependency: only needed to MINT certificates
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+except ModuleNotFoundError:  # insecure/plaintext deployments don't need it
+    x509 = None
 
 
 def generate_self_signed(host: str,
@@ -29,6 +32,10 @@ def generate_self_signed(host: str,
     roots with identical names make the TLS stack pick an arbitrary one
     (handshakes then fail with CERTIFICATE_VERIFY_FAILED).
     """
+    if x509 is None:
+        raise RuntimeError(
+            "TLS certificate generation needs the 'cryptography' package"
+        )
     key = ec.generate_private_key(ec.SECP256R1())
     name = x509.Name(
         [x509.NameAttribute(NameOID.COMMON_NAME, common_name or host)]

@@ -129,6 +129,75 @@ def build_public_server(daemon, address: str,
         for b in daemon.serve_sync_chain(request.from_round):
             yield _beacon_to_record(b)
 
+    async def _verify_gateway(context):
+        # serve/ pulls in the crypto backend; keep the import off the
+        # transport module path
+        try:
+            return await daemon.verify_gateway()
+        except RuntimeError as exc:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION, str(exc)
+            )
+
+    async def verify_beacon(request, context):
+        from drand_tpu import serve
+
+        gw = await _verify_gateway(context)
+        req = serve.VerifyRequest(
+            round=request.round,
+            prev_round=request.previous_round,
+            prev_sig=request.previous_signature,
+            signature=request.signature,
+        )
+        try:
+            res = await gw.verify(req, request.timeout_seconds or None)
+        except serve.Overloaded as exc:
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc)
+            )
+        except serve.DeadlineExceeded as exc:
+            await context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED, str(exc)
+            )
+        except serve.GatewayClosed as exc:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
+        return pb.VerifyBeaconResponse(
+            valid=res.valid, cached=res.cached, batch_size=res.batch_size
+        )
+
+    async def verify_beacon_batch(request, context):
+        from drand_tpu import serve
+
+        gw = await _verify_gateway(context)
+        reqs = [
+            serve.VerifyRequest(
+                round=item.round,
+                prev_round=item.previous_round,
+                prev_sig=item.previous_signature,
+                signature=item.signature,
+            )
+            for item in request.items
+        ]
+        results = await gw.verify_many(
+            reqs, request.timeout_seconds or None
+        )
+        out = []
+        for res in results:
+            if isinstance(res, serve.Overloaded):
+                out.append(pb.VerifyBeaconResponse(error="overloaded"))
+            elif isinstance(res, serve.DeadlineExceeded):
+                out.append(
+                    pb.VerifyBeaconResponse(error="deadline exceeded")
+                )
+            elif isinstance(res, BaseException):
+                await context.abort(grpc.StatusCode.INTERNAL, repr(res))
+            else:
+                out.append(pb.VerifyBeaconResponse(
+                    valid=res.valid, cached=res.cached,
+                    batch_size=res.batch_size,
+                ))
+        return pb.VerifyBeaconBatchResponse(items=out)
+
     async def setup(request, context):
         await _dkg_inbound(daemon, request, context, reshare=False)
         return pb.Empty()
@@ -162,6 +231,18 @@ def build_public_server(daemon, address: str,
             home,
             request_deserializer=pb.HomeRequest.FromString,
             response_serializer=pb.HomeResponse.SerializeToString,
+        ),
+        "VerifyBeacon": grpc.unary_unary_rpc_method_handler(
+            verify_beacon,
+            request_deserializer=pb.VerifyBeaconRequest.FromString,
+            response_serializer=pb.VerifyBeaconResponse.SerializeToString,
+        ),
+        "VerifyBeaconBatch": grpc.unary_unary_rpc_method_handler(
+            verify_beacon_batch,
+            request_deserializer=pb.VerifyBeaconBatchRequest.FromString,
+            response_serializer=(
+                pb.VerifyBeaconBatchResponse.SerializeToString
+            ),
         ),
     }
     protocol_handlers = {
@@ -501,6 +582,56 @@ class GrpcClient(ProtocolClient):
         )
         resp = await call(pb.HomeRequest(), timeout=CONTROL_TIMEOUT)
         return resp.status
+
+    async def verify_beacon(self, peer: Identity, *, round: int,
+                            prev_round: int, prev_sig: bytes,
+                            signature: bytes,
+                            timeout: Optional[float] = None
+                            ) -> "pb.VerifyBeaconResponse":
+        """Remote verification of one chain link through the peer's
+        serve/ gateway.  The peer sheds with RESOURCE_EXHAUSTED /
+        DEADLINE_EXCEEDED instead of holding the call open."""
+        call = self._method(
+            peer, f"/{PUBLIC_SERVICE}/VerifyBeacon",
+            pb.VerifyBeaconRequest.SerializeToString,
+            pb.VerifyBeaconResponse.FromString,
+        )
+        req = pb.VerifyBeaconRequest(
+            round=round, previous_round=prev_round,
+            previous_signature=prev_sig, signature=signature,
+            timeout_seconds=timeout or 0.0,
+        )
+        return await call(
+            req, timeout=(timeout or 0.0) + CONTROL_TIMEOUT
+        )
+
+    async def verify_beacon_batch(self, peer: Identity, items,
+                                  timeout: Optional[float] = None
+                                  ) -> list:
+        """Batch variant: `items` is an iterable of dicts with keys
+        round/prev_round/prev_sig/signature; returns the response items
+        in order (shed ones carry `.error`)."""
+        call = self._method(
+            peer, f"/{PUBLIC_SERVICE}/VerifyBeaconBatch",
+            pb.VerifyBeaconBatchRequest.SerializeToString,
+            pb.VerifyBeaconBatchResponse.FromString,
+        )
+        req = pb.VerifyBeaconBatchRequest(
+            items=[
+                pb.VerifyBeaconRequest(
+                    round=i["round"],
+                    previous_round=i["prev_round"],
+                    previous_signature=i["prev_sig"],
+                    signature=i["signature"],
+                )
+                for i in items
+            ],
+            timeout_seconds=timeout or 0.0,
+        )
+        resp = await call(
+            req, timeout=(timeout or 0.0) + CONTROL_TIMEOUT
+        )
+        return list(resp.items)
 
 
 class ControlClient:

@@ -1,0 +1,42 @@
+"""Verification gateway: serve many concurrent beacon-verify requests
+from one TPU-batched crypto backend.
+
+The crypto plane only hits its measured throughput when fed large
+batches (bench.py: the Pallas pairing kernel does 12-21k pairings/s at
+batch >= 128, but a single-row dispatch pays the same kernel latency).
+Nothing in the tree served that shape of traffic: every PublicRand /
+REST request verified one signature at a time.  This package is the
+inference-server-shaped front end over the batch API:
+
+  client requests -> admission control -> bounded queue -> batcher
+    -> ONE padded fixed-shape device batch per tick -> demux verdicts
+
+plus an LRU verified-round cache (repeat requests never touch the
+kernel) and explicit shedding (429 / RESOURCE_EXHAUSTED) instead of
+unbounded queueing latency.  See README.md "Verification gateway".
+"""
+
+from drand_tpu.serve.batcher import BatchItem, BatchScheduler
+from drand_tpu.serve.cache import VerifiedRoundCache
+from drand_tpu.serve.gateway import (
+    DeadlineExceeded,
+    GatewayClosed,
+    GatewayError,
+    Overloaded,
+    VerifyGateway,
+    VerifyRequest,
+    VerifyResult,
+)
+
+__all__ = [
+    "BatchItem",
+    "BatchScheduler",
+    "DeadlineExceeded",
+    "GatewayClosed",
+    "GatewayError",
+    "Overloaded",
+    "VerifiedRoundCache",
+    "VerifyGateway",
+    "VerifyRequest",
+    "VerifyResult",
+]

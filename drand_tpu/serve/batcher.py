@@ -1,0 +1,144 @@
+"""Batch scheduler: coalesce queued requests into device-sized batches.
+
+The continuous-batching core of the gateway, shaped like a model
+server's request scheduler: a bounded queue feeds a single consumer
+loop that flushes a batch when EITHER it holds `max_batch` items OR
+`max_wait` has elapsed since the first item arrived — so p50 latency
+stays one tick under light load while batches fill (and throughput
+saturates) under heavy load.  While a flush is executing, the next
+batch accumulates in the queue; there is never more than one batch in
+flight, which keeps the device stream serialized and the jitted kernel
+on one compiled shape bucket (tbls.JaxScheme._bucket pads the rest).
+
+Admission control is the queue bound: `submit` raises
+`asyncio.QueueFull` (translated to an explicit 429/RESOURCE_EXHAUSTED
+by the gateway) instead of queueing unbounded latency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, List, Optional
+
+from drand_tpu.utils.logging import get_logger
+
+log = get_logger("serve.batcher")
+
+
+@dataclass
+class BatchItem:
+    """One queued verification unit.
+
+    `deadline` is an absolute event-loop time; the flush callback drops
+    items already past it (reject-at-pop, never serve-late).  `payload`
+    is opaque to the scheduler — the gateway stores its request there.
+    """
+
+    payload: object
+    deadline: Optional[float] = None
+    future: "asyncio.Future" = field(
+        default_factory=lambda: asyncio.get_event_loop().create_future()
+    )
+
+
+class BatchScheduler:
+    """Bounded queue + flush loop.  `flush(items)` is an async callback
+    that must resolve every item's future (verdict or exception)."""
+
+    def __init__(self, flush: Callable[[List[BatchItem]], Awaitable[None]],
+                 *, max_batch: int = 128, max_wait: float = 0.005,
+                 max_queue: int = 1024):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self._flush = flush
+        self.max_batch = max_batch
+        self.max_wait = max_wait
+        self._queue: "asyncio.Queue[BatchItem]" = asyncio.Queue(
+            maxsize=max_queue
+        )
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    # -- producer side ----------------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        return self._queue.qsize()
+
+    def submit(self, item: BatchItem) -> None:
+        """Enqueue or raise asyncio.QueueFull (shed) synchronously —
+        admission must never itself wait behind the backlog."""
+        if self._closed:
+            raise RuntimeError("scheduler is closed")
+        self._queue.put_nowait(item)
+
+    # -- consumer loop -----------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_event_loop().create_task(self._run())
+
+    async def close(self) -> None:
+        """Stop the loop and fail everything still queued."""
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        while not self._queue.empty():
+            item = self._queue.get_nowait()
+            if not item.future.done():
+                item.future.set_exception(
+                    RuntimeError("scheduler closed")
+                )
+
+    async def _collect(self) -> List[BatchItem]:
+        """One batch: first item blocks; then fill until max_batch or
+        max_wait past the first arrival, whichever comes first."""
+        loop = asyncio.get_event_loop()
+        first = await self._queue.get()
+        batch = [first]
+        flush_at = loop.time() + self.max_wait
+        while len(batch) < self.max_batch:
+            # drain whatever is already queued without touching timers
+            try:
+                batch.append(self._queue.get_nowait())
+                continue
+            except asyncio.QueueEmpty:
+                pass
+            remaining = flush_at - loop.time()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(
+                    await asyncio.wait_for(self._queue.get(), remaining)
+                )
+            except asyncio.TimeoutError:
+                break
+        return batch
+
+    async def _run(self) -> None:
+        while True:
+            batch = await self._collect()
+            try:
+                await self._flush(batch)
+            except asyncio.CancelledError:
+                for item in batch:
+                    if not item.future.done():
+                        item.future.set_exception(
+                            RuntimeError("scheduler closed")
+                        )
+                raise
+            except Exception as exc:  # noqa: BLE001 — keep serving
+                # a backend fault must fail THIS batch loudly, not kill
+                # the loop for every future request
+                log.error("batch flush failed", error=repr(exc))
+                for item in batch:
+                    if not item.future.done():
+                        item.future.set_exception(exc)

@@ -1,0 +1,61 @@
+"""LRU cache of already-verified rounds.
+
+Same role as `beacon/round_cache.py` plays for partials — bounded
+per-round state in front of the expensive crypto — but keyed on the
+full beacon identity, because the gateway serves arbitrary (round,
+signature) claims from untrusted clients, not just the active round.
+
+Only VALID verdicts are cached.  An invalid signature is unbounded
+attacker-chosen garbage: caching it would let a flood of junk evict the
+real entries, while re-verifying junk just re-charges the attacker the
+kernel cost.  A valid beacon, by contrast, is unique per round (BLS is
+deterministic), so the cache is naturally bounded by chain length.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Hashable
+
+
+class VerifiedRoundCache:
+    """Bounded LRU set of verified beacon identities.
+
+    Thread-safe: the gateway reads it from the event loop but flush
+    callbacks may run completions from executor threads.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 0:
+            raise ValueError("capacity must be >= 0")
+        self.capacity = capacity
+        self._entries: "OrderedDict[Hashable, None]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: Hashable) -> bool:
+        return self.hit(key)
+
+    def hit(self, key: Hashable) -> bool:
+        """True (and refresh recency) if `key` was verified before."""
+        with self._lock:
+            if key not in self._entries:
+                return False
+            self._entries.move_to_end(key)
+            return True
+
+    def add(self, key: Hashable) -> None:
+        if self.capacity == 0:
+            return
+        with self._lock:
+            self._entries[key] = None
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()

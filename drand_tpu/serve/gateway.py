@@ -1,0 +1,305 @@
+"""The verification gateway: many concurrent callers, one batched kernel.
+
+`VerifyGateway.verify` is the whole public surface: await it with a
+(round, prev_round, prev_sig, signature) claim and get a verdict.
+Internally a request flows
+
+  cache probe -> in-flight coalescing -> admission control (bounded
+  queue, else shed) -> BatchScheduler tick -> one padded
+  `verify_chain_batch` device call -> per-request demux
+
+The crypto backend is any `tbls.Scheme`: JaxScheme turns each tick into
+a single fixed-shape Pallas/op-graph dispatch (its `_bucket` padding
+means the jitted kernel never re-traces); NativeScheme/RefScheme serve
+the same contract off-TPU.  The kernel call runs in a one-thread
+executor so the event loop keeps admitting (and shedding) while the
+device is busy.
+
+Failure semantics are explicit, never silent latency:
+* queue full            -> `Overloaded`       (REST 429 / gRPC
+                           RESOURCE_EXHAUSTED)
+* deadline passed while
+  queued                -> `DeadlineExceeded` (rejected at batch
+                           assembly — a late verdict is never served)
+* gateway closed        -> `GatewayClosed`
+"""
+
+from __future__ import annotations
+
+import asyncio
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from drand_tpu.beacon.chain import Beacon, beacon_message
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto import tbls
+from drand_tpu.serve.batcher import BatchItem, BatchScheduler
+from drand_tpu.serve.cache import VerifiedRoundCache
+from drand_tpu.utils import metrics
+from drand_tpu.utils.logging import get_logger
+
+log = get_logger("serve.gateway")
+
+#: batch occupancy is size-shaped, not latency-shaped
+_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                  512.0, 1024.0)
+
+_queue_depth = metrics.gauge(
+    "drand_serve_queue_depth", "verification requests waiting for a batch"
+)
+_batch_size = metrics.histogram(
+    "drand_serve_batch_size", "requests per kernel batch",
+    buckets=_BATCH_BUCKETS,
+)
+_batch_seconds = metrics.histogram(
+    "drand_serve_batch_seconds", "wall time of one batched verify call"
+)
+_cache_hits = metrics.counter(
+    "drand_serve_cache_hits_total", "requests served from the "
+    "verified-round cache without touching the kernel"
+)
+_coalesced = metrics.counter(
+    "drand_serve_coalesced_total", "requests attached to an identical "
+    "in-flight verification"
+)
+_shed = {
+    reason: metrics.counter(
+        "drand_serve_shed_total",
+        "requests rejected instead of served late",
+        labels={"reason": reason},
+    )
+    for reason in ("queue_full", "deadline")
+}
+_requests = {
+    result: metrics.counter(
+        "drand_serve_requests_total", "verification verdicts returned",
+        labels={"result": result},
+    )
+    for result in ("valid", "invalid")
+}
+
+
+def _consume_exception(fut: "asyncio.Future") -> None:
+    if not fut.cancelled():
+        fut.exception()
+
+
+class GatewayError(Exception):
+    """Base class for explicit gateway rejections."""
+
+
+class Overloaded(GatewayError):
+    """Admission control shed the request (queue at capacity)."""
+
+
+class DeadlineExceeded(GatewayError):
+    """The request's deadline passed before its batch was assembled."""
+
+
+class GatewayClosed(GatewayError):
+    """The gateway is shut down."""
+
+
+@dataclass(frozen=True)
+class VerifyRequest:
+    """One beacon-verification claim (the chain link + its signature)."""
+
+    round: int
+    prev_round: int
+    prev_sig: bytes
+    signature: bytes
+
+    @classmethod
+    def from_beacon(cls, b: Beacon) -> "VerifyRequest":
+        return cls(round=b.round, prev_round=b.prev_round,
+                   prev_sig=b.prev_sig, signature=b.signature)
+
+    def message(self) -> bytes:
+        return beacon_message(self.prev_sig, self.prev_round, self.round)
+
+    def key(self) -> tuple:
+        """Cache/coalescing identity: the full claim, so a forged
+        signature for a cached round can never alias a real verdict."""
+        return (self.round, self.prev_round, self.prev_sig,
+                self.signature)
+
+
+@dataclass(frozen=True)
+class VerifyResult:
+    valid: bool
+    cached: bool = False
+    #: live size of the kernel batch that produced the verdict (0 when
+    #: the cache answered)
+    batch_size: int = 0
+
+
+class VerifyGateway:
+    """Dynamic-batching verification front end over one `tbls.Scheme`.
+
+    `dist_key` is the collective G1 public key — an oracle affine point
+    or its 48-byte compressed encoding.
+    """
+
+    def __init__(self, dist_key, scheme: Optional[tbls.Scheme] = None, *,
+                 max_batch: int = 128, max_wait: float = 0.005,
+                 max_queue: int = 1024, cache_size: int = 4096,
+                 default_timeout: float = 5.0):
+        if isinstance(dist_key, (bytes, bytearray)):
+            dist_key = ref.g1_from_bytes(bytes(dist_key))
+        self.dist_key = dist_key
+        self.scheme = scheme or tbls.default_scheme()
+        self.default_timeout = default_timeout
+        self.cache = VerifiedRoundCache(cache_size)
+        self._batcher = BatchScheduler(
+            self._flush, max_batch=max_batch, max_wait=max_wait,
+            max_queue=max_queue,
+        )
+        #: key -> BatchItem for claims already queued: identical claims
+        #: share one kernel slot and one verdict
+        self._inflight: Dict[tuple, BatchItem] = {}
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._started = False
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        # one worker: the device stream is serial anyway, and a second
+        # concurrent dispatch would only fight for the same chip
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="verify-gateway"
+        )
+        self._batcher.start()
+        log.info("verification gateway started",
+                 max_batch=self._batcher.max_batch,
+                 max_wait=self._batcher.max_wait,
+                 backend=type(self.scheme).__name__)
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        await self._batcher.close()
+        for item in list(self._inflight.values()):
+            if not item.future.done():
+                item.future.set_exception(GatewayClosed("gateway closed"))
+        self._inflight.clear()
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None
+
+    async def __aenter__(self) -> "VerifyGateway":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # -- request path ------------------------------------------------------
+
+    async def verify(self, req: VerifyRequest,
+                     timeout: Optional[float] = None) -> VerifyResult:
+        """Verify one claim; returns a verdict or raises a GatewayError."""
+        if self._closed or not self._started:
+            raise GatewayClosed("gateway is not serving")
+        key = req.key()
+        if self.cache.hit(key):
+            _cache_hits.inc()
+            _requests["valid"].inc()
+            return VerifyResult(valid=True, cached=True)
+
+        loop = asyncio.get_event_loop()
+        timeout = self.default_timeout if timeout is None else timeout
+        deadline = loop.time() + timeout
+        item = self._inflight.get(key)
+        if item is not None and not item.future.done():
+            # identical claim already queued: ride its kernel slot, and
+            # keep the slot alive to the LATEST interested deadline
+            if item.deadline is not None:
+                item.deadline = max(item.deadline, deadline)
+            _coalesced.inc()
+        else:
+            if timeout <= 0:
+                _shed["deadline"].inc()
+                raise DeadlineExceeded("deadline expired before admission")
+            item = BatchItem(payload=req, deadline=deadline,
+                             future=loop.create_future())
+            # every waiter may abandon the slot (wait_for timeout); mark
+            # a late exception as retrieved so GC never logs noise
+            item.future.add_done_callback(_consume_exception)
+            try:
+                self._batcher.submit(item)
+            except asyncio.QueueFull:
+                _shed["queue_full"].inc()
+                raise Overloaded(
+                    f"verification queue full "
+                    f"({self._batcher._queue.maxsize} deep); retry later"
+                ) from None
+            self._inflight[key] = item
+            _queue_depth.set(self._batcher.depth)
+        # outer wait_for is a backstop for coalesced waiters whose own
+        # deadline is earlier than the slot's extended one
+        try:
+            return await asyncio.wait_for(
+                asyncio.shield(item.future), timeout
+            )
+        except asyncio.TimeoutError:
+            _shed["deadline"].inc()
+            raise DeadlineExceeded(
+                f"no verdict within {timeout:.3f}s"
+            ) from None
+
+    async def verify_many(self, reqs: Sequence[VerifyRequest],
+                          timeout: Optional[float] = None
+                          ) -> List[VerifyResult]:
+        """Concurrent verify of several claims (they share batches);
+        per-item GatewayErrors come back in-place as exceptions."""
+        return await asyncio.gather(
+            *(self.verify(r, timeout) for r in reqs),
+            return_exceptions=True,
+        )
+
+    # -- batch flush (BatchScheduler callback) -----------------------------
+
+    def _run_kernel(self, msgs: List[bytes],
+                    sigs: List[bytes]) -> List[bool]:
+        return self.scheme.verify_chain_batch(self.dist_key, msgs, sigs)
+
+    async def _flush(self, items: List[BatchItem]) -> None:
+        loop = asyncio.get_event_loop()
+        _queue_depth.set(self._batcher.depth)
+        now = loop.time()
+        live: List[BatchItem] = []
+        for item in items:
+            req = item.payload
+            self._inflight.pop(req.key(), None)
+            if item.deadline is not None and now > item.deadline:
+                _shed["deadline"].inc()
+                if not item.future.done():
+                    item.future.set_exception(DeadlineExceeded(
+                        "deadline passed while queued"
+                    ))
+                continue
+            live.append(item)
+        if not live:
+            return
+        msgs = [item.payload.message() for item in live]
+        sigs = [item.payload.signature for item in live]
+        _batch_size.observe(float(len(live)))
+        with _batch_seconds.time():
+            verdicts = await loop.run_in_executor(
+                self._executor, self._run_kernel, msgs, sigs
+            )
+        for item, ok in zip(live, verdicts):
+            ok = bool(ok)
+            _requests["valid" if ok else "invalid"].inc()
+            if ok:
+                self.cache.add(item.payload.key())
+            if not item.future.done():
+                item.future.set_result(
+                    VerifyResult(valid=ok, batch_size=len(live))
+                )

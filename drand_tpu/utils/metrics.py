@@ -108,12 +108,13 @@ class Registry:
         ] = {}
         self._help: Dict[str, Tuple[str, str]] = {}  # name -> (type, help)
 
-    def _get(self, kind, name: str, help: str, labels: Optional[dict]):
+    def _get(self, kind, name: str, help: str, labels: Optional[dict],
+             **kwargs):
         key = (name, tuple(sorted((labels or {}).items())))
         with self._lock:
             m = self._metrics.get(key)
             if m is None:
-                m = kind()
+                m = kind(**kwargs)
                 self._metrics[key] = m
                 self._help.setdefault(
                     name,
@@ -137,7 +138,14 @@ class Registry:
         return self._get(Gauge, name, help, labels)
 
     def histogram(self, name: str, help: str = "",
-                  labels: Optional[dict] = None) -> Histogram:
+                  labels: Optional[dict] = None,
+                  buckets: Optional[Tuple[float, ...]] = None) -> Histogram:
+        """`buckets` overrides the latency-oriented defaults (used for
+        size-shaped distributions like batch occupancy); it only applies
+        on first registration of a (name, labels) series."""
+        if buckets is not None:
+            return self._get(Histogram, name, help, labels,
+                             buckets=buckets)
         return self._get(Histogram, name, help, labels)
 
     def render(self) -> str:

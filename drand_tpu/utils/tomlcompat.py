@@ -1,0 +1,149 @@
+"""`tomllib` with a Python 3.10 fallback.
+
+The repo targets stdlib-only TOML reading (`import tomllib`, 3.11+).  On
+3.10 hosts that import fails, so this module re-exports the stdlib
+parser when present and otherwise provides a minimal reader for exactly
+the dialect `drand_tpu.utils.toml_dumps` emits (and the hand-written
+group files in tests/demos): scalar assignments, lists of scalars,
+`[table]` sections and `[[table]]` array-of-table sections.  It is NOT
+a general TOML parser — nested tables, inline tables, multi-line
+strings and dates are out of scope and raise.
+
+Use it everywhere the repo reads TOML:
+
+    from drand_tpu.utils import tomlcompat as tomllib
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+try:  # Python 3.11+
+    from tomllib import TOMLDecodeError, load, loads  # noqa: F401
+
+except ModuleNotFoundError:  # pragma: no cover - exercised on 3.10 hosts
+
+    class TOMLDecodeError(ValueError):
+        """Raised on input outside the supported TOML subset."""
+
+    def load(fp) -> Dict[str, Any]:
+        """Parse a binary file object (same contract as tomllib.load)."""
+        data = fp.read()
+        if isinstance(data, bytes):
+            data = data.decode("utf-8")
+        return loads(data)
+
+    def loads(text: str) -> Dict[str, Any]:
+        root: Dict[str, Any] = {}
+        target = root  # dict currently receiving assignments
+
+        for lineno, raw in enumerate(text.splitlines(), 1):
+            line = _strip_comment(raw).strip()
+            if not line:
+                continue
+            if line.startswith("[[") and line.endswith("]]"):
+                name = line[2:-2].strip()
+                _check_key(name, lineno)
+                target = {}
+                root.setdefault(name, []).append(target)
+            elif line.startswith("[") and line.endswith("]"):
+                name = line[1:-1].strip()
+                _check_key(name, lineno)
+                target = root.setdefault(name, {})
+            elif "=" in line:
+                key, _, value = line.partition("=")
+                key = key.strip()
+                _check_key(key, lineno)
+                target[key] = _parse_value(value.strip(), lineno)
+            else:
+                raise TOMLDecodeError(
+                    f"line {lineno}: cannot parse {raw!r}"
+                )
+        return root
+
+    def _strip_comment(line: str) -> str:
+        out = []
+        in_str = False
+        i = 0
+        while i < len(line):
+            c = line[i]
+            if c == '"' and (i == 0 or line[i - 1] != "\\"):
+                in_str = not in_str
+            elif c == "#" and not in_str:
+                break
+            out.append(c)
+            i += 1
+        return "".join(out)
+
+    def _check_key(key: str, lineno: int) -> None:
+        if not key or "." in key or '"' in key or "'" in key:
+            raise TOMLDecodeError(f"line {lineno}: bad key {key!r}")
+
+    def _parse_value(value: str, lineno: int) -> Any:
+        if value.startswith("[") and value.endswith("]"):
+            inner = value[1:-1].strip()
+            return [
+                _parse_value(part, lineno)
+                for part in _split_list(inner, lineno)
+            ]
+        if value.startswith('"') and value.endswith('"') and len(value) >= 2:
+            return _unescape(value[1:-1], lineno)
+        if value == "true":
+            return True
+        if value == "false":
+            return False
+        try:
+            return int(value)
+        except ValueError:
+            pass
+        try:
+            return float(value)
+        except ValueError:
+            pass
+        raise TOMLDecodeError(f"line {lineno}: bad value {value!r}")
+
+    def _split_list(inner: str, lineno: int) -> List[str]:
+        parts: List[str] = []
+        buf = []
+        in_str = False
+        for i, c in enumerate(inner):
+            if c == '"' and (i == 0 or inner[i - 1] != "\\"):
+                in_str = not in_str
+                buf.append(c)
+            elif c == "," and not in_str:
+                part = "".join(buf).strip()
+                if part:
+                    parts.append(part)
+                buf = []
+            else:
+                buf.append(c)
+        if in_str:
+            raise TOMLDecodeError(f"line {lineno}: unterminated string")
+        tail = "".join(buf).strip()
+        if tail:
+            parts.append(tail)
+        return parts
+
+    def _unescape(s: str, lineno: int) -> str:
+        out = []
+        i = 0
+        while i < len(s):
+            c = s[i]
+            if c == "\\":
+                if i + 1 >= len(s):
+                    raise TOMLDecodeError(
+                        f"line {lineno}: dangling escape"
+                    )
+                nxt = s[i + 1]
+                mapped = {"\\": "\\", '"': '"', "n": "\n", "t": "\t",
+                          "r": "\r"}.get(nxt)
+                if mapped is None:
+                    raise TOMLDecodeError(
+                        f"line {lineno}: unsupported escape \\{nxt}"
+                    )
+                out.append(mapped)
+                i += 2
+            else:
+                out.append(c)
+                i += 1
+        return "".join(out)

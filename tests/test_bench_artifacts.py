@@ -1,0 +1,45 @@
+"""The committed bench_matrix artifact pair must stay self-consistent.
+
+ADVICE r5 #5 caught a snapshot where the .log recorded three configs but
+the jsonl held two rows — a mid-run copy. tools/bench_matrix.sh now
+truncates both files at start and emits a row even for failed configs,
+so a *completed* run always matches; this test pins that invariant on
+the committed pair so a torn snapshot can never land again. Pure file
+parsing — fast tier.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+JSONL = REPO / "bench_matrix.jsonl"
+LOG = REPO / "bench_matrix.jsonl.log"
+
+
+@pytest.mark.skipif(not JSONL.exists(), reason="no committed bench matrix")
+def test_bench_matrix_rows_match_log_configs():
+    rows = [json.loads(line) for line in JSONL.read_text().splitlines()
+            if line.strip()]
+    assert rows, "bench_matrix.jsonl is empty"
+    row_cfgs = [r["cfg"] for r in rows]
+    assert len(set(row_cfgs)) == len(row_cfgs), "duplicate config rows"
+
+    log_cfgs = [line[4:].rsplit(" (", 1)[0]
+                for line in LOG.read_text().splitlines()
+                if line.startswith("### ")]
+    assert row_cfgs == log_cfgs, (
+        "bench_matrix.jsonl rows and .log configs diverge — recommit the "
+        "pair from a completed tools/bench_matrix.sh run"
+    )
+
+
+@pytest.mark.skipif(not JSONL.exists(), reason="no committed bench matrix")
+def test_bench_matrix_rows_are_complete():
+    for row in (json.loads(l) for l in JSONL.read_text().splitlines()
+                if l.strip()):
+        if row.get("failed"):
+            assert "rc" in row, row  # failures carry their exit code
+            continue
+        assert {"metric", "value", "unit", "detail"} <= row.keys(), row

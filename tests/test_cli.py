@@ -9,7 +9,7 @@ import shutil
 import subprocess
 import sys
 import time
-import tomllib
+from drand_tpu.utils import tomlcompat as tomllib
 from pathlib import Path
 
 import pytest

@@ -186,6 +186,10 @@ async def test_full_dkg_beacon_client_rest():
         await d.stop()
 
 
+# two chained DKGs on the oracle backend, ~2 min on a 1-core host —
+# slow tier (test_full_dkg_beacon_client_rest keeps the per-push
+# daemon-level DKG signal)
+@pytest.mark.slow
 @pytest.mark.asyncio
 async def test_daemon_reshare_transition():
     """Full resharing over real gRPC (reference core/drand_test.go

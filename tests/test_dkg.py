@@ -191,6 +191,10 @@ async def test_handler_dkg_timeout_with_offline_node():
     assert ref.g1_mul(ref.G1_GEN, secret) == shares[0].commits[0]
 
 
+# reshare scenarios run TWO full DKGs on the pure-Python oracle
+# (~2 min each on a 1-core host) — slow tier; the fresh-DKG engine and
+# handler paths above keep per-push coverage
+@pytest.mark.slow
 @pytest.mark.asyncio
 async def test_handler_reshare_preserves_collective_key():
     # fresh 3-of-4, then reshare to 4-of-6 (two new members)
@@ -237,6 +241,7 @@ async def test_handler_reshare_preserves_collective_key():
     assert new_shares[0].share.value != old_shares[0].share.value
 
 
+@pytest.mark.slow  # see test_handler_reshare_preserves_collective_key
 @pytest.mark.asyncio
 async def test_handler_reshare_with_retiring_nonleader_node():
     """Regression: an old-only node that is NOT the leader receives no

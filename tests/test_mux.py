@@ -8,12 +8,20 @@ import aiohttp
 import pytest
 
 from drand_tpu.key import Identity, Pair
+from drand_tpu.net import tls as tls_mod
 from drand_tpu.net.mux import start_mux
 from drand_tpu.net.rest import build_rest_app, start_rest
 from drand_tpu.net.tls import CertManager, generate_self_signed
 from drand_tpu.net.transport import GrpcClient, build_public_server
 
 from test_core import free_ports
+
+# minting self-signed certs needs the optional 'cryptography' package
+# (net/tls.py gates it); the insecure-mux tests below don't
+_needs_certgen = pytest.mark.skipif(
+    tls_mod.x509 is None,
+    reason="self-signed cert generation needs the 'cryptography' package",
+)
 
 
 class _FakeDaemon:
@@ -63,6 +71,7 @@ async def test_mux_insecure_grpc_and_rest_share_one_port():
         await server.stop(0.1)
 
 
+@_needs_certgen
 @pytest.mark.asyncio
 async def test_mux_tls_single_port(tmp_path):
     (port,) = free_ports(1)
@@ -306,6 +315,7 @@ async def test_mux_pipelined_http11_one_connection():
         await server.stop(0.1)
 
 
+@_needs_certgen
 @pytest.mark.asyncio
 async def test_mux_tls_client_without_alpn(tmp_path):
     """A TLS client that never offers ALPN (old curl, raw openssl) must

@@ -287,13 +287,13 @@ def test_bit_patterns_match():
             assert got == int(bits[i]), (name, i)
 
 
-def run_rows_conv(fn, out_rows, conv, *arrays):
-    """run_rows with an explicit constant-conv mode (mxu/kara paths)."""
+def run_rows_conv(fn, out_rows, conv, *arrays, miller="split"):
+    """run_rows with explicit conv/miller modes (mxu/kara/shared paths)."""
 
     def kern(consts_ref, toep_ref, *refs):
         out_ref = refs[-1]
         ins = [r[:] for r in refs[:-1]]
-        pp._set_ctx(consts_ref, toep_ref, conv)
+        pp._set_ctx(consts_ref, toep_ref, conv, miller)
         out_ref[:] = fn(*ins)
         pp._CTX.clear()
 
@@ -343,6 +343,70 @@ def test_conv_const_mxu_limb_boundaries():
         want = np.asarray(run_rows(fn, width, arr))
         got = np.asarray(run_rows_conv(fn, width, "mxu", arr))
         np.testing.assert_array_equal(got, want)
+
+
+def test_miller_shared_matches_split():
+    """The fused two-point Miller loop (DRAND_TPU_MILLER=shared,
+    pallas_pairing._miller_pair) must decode identically to the split
+    composition fp12_mul_lazy(_miller(P1,Q1), _miller(P2,Q2)).
+
+    The algebra is bit-pattern independent — the fused accumulator keeps
+    the invariant f = f1*f2 through every dbl/add step, and the final
+    conjugation distributes over the product — so the interpreter run
+    uses a minimal segment-structured pattern (adjacent one-bits, then a
+    zero run) instead of the 63-bit |x|, which the Pallas interpreter
+    cannot finish in CI time (even 8 bits blows a 10-minute budget on a
+    1-core host; the cost is XLA compiling the scan body, so lanes and
+    conv mode barely matter).  conv="mxu" compiles the smallest step
+    body (matmul conv instead of unrolled schoolbook); conv-mode
+    correctness is test_conv_modes_match_vpu's job, not this test's.
+    The real pattern runs on hardware via the DRAND_TPU_MILLER=shared
+    row of tools/bench_matrix.sh."""
+    real_bits = pp.MILLER_BITS
+    pp.MILLER_BITS = np.array([1, 1, 0], dtype=np.int32)
+    try:
+        def rand_col():
+            return jnp.asarray(np.stack(
+                [col(rng.randrange(ref.P)) for _ in range(B)], axis=1
+            ))
+
+        def rand_fp2():
+            return jnp.asarray(np.concatenate(
+                [np.asarray(rand_col()), np.asarray(rand_col())], axis=0
+            ))
+
+        p1x, p1y, p2x, p2y = (rand_col() for _ in range(4))
+        q1x, q1y, q2x, q2y = (rand_fp2() for _ in range(4))
+
+        def unpack2(u):
+            return (u[: pp.NL], u[pp.NL :])
+
+        def shared(ax, ay, cx, cy, dx, dy, ex, ey):
+            g = pp._miller_pair(
+                ax, ay, (unpack2(cx), unpack2(cy)),
+                dx, dy, (unpack2(ex), unpack2(ey)), B,
+            )
+            return pp._fp12_to_stack(g).reshape(12 * pp.NL, B)
+
+        def split(ax, ay, cx, cy, dx, dy, ex, ey):
+            f1 = pp._miller(ax, ay, unpack2(cx), unpack2(cy), B)
+            f2 = pp._miller(dx, dy, unpack2(ex), unpack2(ey), B)
+            return pp._fp12_to_stack(pp.fp12_mul_lazy(f1, f2)).reshape(
+                12 * pp.NL, B
+            )
+
+        args = (p1x, p1y, q1x, q1y, p2x, p2y, q2x, q2y)
+        got = np.asarray(
+            run_rows_conv(shared, 12 * pp.NL, "mxu", *args,
+                          miller="shared")
+        )
+        want = np.asarray(
+            run_rows_conv(split, 12 * pp.NL, "mxu", *args)
+        )
+        for lane in range(B):
+            assert _unpack12(got, lane) == _unpack12(want, lane), lane
+    finally:
+        pp.MILLER_BITS = real_bits
 
 
 def test_fused_dbl_and_line_matches_separate_ops():

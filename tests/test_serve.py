@@ -1,0 +1,330 @@
+"""serve/ gateway: concurrency correctness, cache, shedding, deadlines.
+
+Fast tier: the crypto backend is a stub scheme (the real batched-kernel
+equivalence is covered by tests/test_tbls.py and the slow E2E suites),
+so these tests pin down the QUEUEING semantics — the part a kernel test
+cannot see: verdict demux under concurrency, cache bypass, explicit
+shed on overflow, and reject-at-pop deadline handling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+import pytest
+
+from drand_tpu.serve import (
+    BatchScheduler,
+    DeadlineExceeded,
+    GatewayClosed,
+    Overloaded,
+    VerifiedRoundCache,
+    VerifyGateway,
+    VerifyRequest,
+)
+
+class StubScheme:
+    """tbls.Scheme stand-in: verdict = signature starts with b'ok'.
+
+    Records every batch so tests can assert what reached the "kernel";
+    an optional gate blocks inside the call (it runs on the gateway's
+    executor thread, so the event loop stays free — exactly like a long
+    device dispatch).
+    """
+
+    def __init__(self, gate: threading.Event = None):
+        self.batches = []
+        self.gate = gate
+
+    def verify_chain_batch(self, pub, msgs, sigs):
+        if self.gate is not None:
+            assert self.gate.wait(10.0), "test gate never released"
+        self.batches.append(list(msgs))
+        return [sig.startswith(b"ok") for sig in sigs]
+
+    @property
+    def calls(self):
+        return len(self.batches)
+
+    @property
+    def seen(self):
+        return [m for batch in self.batches for m in batch]
+
+
+def req(round: int, valid: bool = True) -> VerifyRequest:
+    sig = (b"ok" if valid else b"no") + round.to_bytes(8, "big")
+    return VerifyRequest(round=round, prev_round=round - 1,
+                         prev_sig=b"\x01" * 96, signature=sig)
+
+
+def gateway(scheme=None, **kw) -> VerifyGateway:
+    kw.setdefault("max_wait", 0.02)
+    return VerifyGateway(object(), scheme or StubScheme(), **kw)
+
+
+# -- batching + demux -------------------------------------------------------
+
+
+async def test_concurrent_mixed_verdicts_demuxed_correctly():
+    """40 concurrent clients, valid/invalid interleaved: every caller
+    gets ITS verdict back, and they share far fewer kernel calls than
+    requests (that is the point of the gateway)."""
+    scheme = StubScheme()
+    async with gateway(scheme, max_batch=64) as gw:
+        reqs = [req(r, valid=(r % 3 != 0)) for r in range(1, 41)]
+        results = await asyncio.gather(*(gw.verify(r) for r in reqs))
+        for r, res in zip(reqs, results):
+            assert res.valid == (r.round % 3 != 0), r
+            assert not res.cached
+        assert scheme.calls < len(reqs)
+        assert sorted(scheme.seen) == sorted(r.message() for r in reqs)
+
+
+async def test_batches_split_at_max_batch():
+    scheme = StubScheme()
+    async with gateway(scheme, max_batch=4) as gw:
+        results = await asyncio.gather(
+            *(gw.verify(req(r)) for r in range(1, 11))
+        )
+    assert all(r.valid for r in results)
+    assert sorted(len(b) for b in scheme.batches) == [2, 4, 4]
+
+
+async def test_identical_claims_coalesce_to_one_slot():
+    scheme = StubScheme()
+    async with gateway(scheme) as gw:
+        same = req(7)
+        r1, r2, r3 = await asyncio.gather(
+            gw.verify(same), gw.verify(same), gw.verify(same)
+        )
+    assert r1.valid and r2.valid and r3.valid
+    assert scheme.seen == [same.message()]
+
+
+async def test_verify_many_reports_per_item():
+    async with gateway() as gw:
+        results = await gw.verify_many([req(1), req(2, valid=False)])
+    assert [r.valid for r in results] == [True, False]
+
+
+# -- cache ------------------------------------------------------------------
+
+
+async def test_cache_hit_bypasses_kernel():
+    scheme = StubScheme()
+    async with gateway(scheme) as gw:
+        first = await gw.verify(req(5))
+        calls = scheme.calls
+        second = await gw.verify(req(5))
+    assert first.valid and not first.cached
+    assert second.valid and second.cached and second.batch_size == 0
+    assert scheme.calls == calls  # no new kernel work
+
+
+async def test_invalid_verdicts_are_not_cached():
+    scheme = StubScheme()
+    async with gateway(scheme) as gw:
+        bad = req(5, valid=False)
+        r1 = await gw.verify(bad)
+        r2 = await gw.verify(bad)
+    assert not r1.valid and not r2.valid
+    assert not r2.cached
+    assert scheme.seen == [bad.message()] * 2  # re-verified
+
+
+async def test_forged_signature_does_not_alias_cached_round():
+    """Caching is by full claim: a different signature for an already-
+    verified round must reach the kernel (and fail), not hit the cache."""
+    scheme = StubScheme()
+    async with gateway(scheme) as gw:
+        await gw.verify(req(5))
+        forged = VerifyRequest(round=5, prev_round=4,
+                               prev_sig=b"\x01" * 96,
+                               signature=b"no-forged")
+        res = await gw.verify(forged)
+    assert not res.valid and not res.cached
+
+
+def test_cache_lru_eviction():
+    c = VerifiedRoundCache(capacity=2)
+    c.add("a")
+    c.add("b")
+    assert c.hit("a")  # refreshes "a"; "b" is now oldest
+    c.add("c")
+    assert "a" in c and "c" in c and "b" not in c
+    assert len(c) == 2
+    c.clear()
+    assert len(c) == 0
+
+
+# -- admission control / shedding ------------------------------------------
+
+
+async def test_queue_overflow_sheds_explicitly():
+    gate = threading.Event()
+    scheme = StubScheme(gate)
+    async with gateway(scheme, max_queue=2) as gw:
+        # first request is popped into the (blocked) flush; the next two
+        # fill the queue; the fourth must shed NOW, not wait
+        blocked = asyncio.ensure_future(gw.verify(req(1)))
+        await asyncio.sleep(0.05)  # let the batcher enter the kernel
+        queued = [asyncio.ensure_future(gw.verify(req(r)))
+                  for r in (2, 3)]
+        await asyncio.sleep(0)  # tasks run up to their first await
+        with pytest.raises(Overloaded):
+            await gw.verify(req(4))
+        gate.set()
+        results = await asyncio.gather(blocked, *queued)
+    assert all(r.valid for r in results)
+    assert req(4).message() not in scheme.seen  # never reached the kernel
+
+
+async def test_deadline_exceeded_rejected_not_served_late():
+    gate = threading.Event()
+    scheme = StubScheme(gate)
+    async with gateway(scheme) as gw:
+        filler = asyncio.ensure_future(gw.verify(req(1)))
+        await asyncio.sleep(0.05)  # filler batch now blocks the kernel
+        late = asyncio.ensure_future(gw.verify(req(2), timeout=0.05))
+        await asyncio.sleep(0.15)  # deadline passes while queued
+        gate.set()
+        with pytest.raises(DeadlineExceeded):
+            await late
+        assert (await filler).valid
+        # drain: the expired claim must never have reached the kernel
+        await asyncio.sleep(0.05)
+    assert req(2).message() not in scheme.seen
+
+
+async def test_nonpositive_timeout_rejected_at_admission():
+    async with gateway() as gw:
+        with pytest.raises(DeadlineExceeded):
+            await gw.verify(req(1), timeout=0.0)
+
+
+async def test_closed_gateway_refuses():
+    gw = gateway()
+    async with gw:
+        pass
+    with pytest.raises(GatewayClosed):
+        await gw.verify(req(1))
+
+
+# -- scheduler unit behaviour ----------------------------------------------
+
+
+async def test_scheduler_flush_error_fails_batch_not_loop():
+    """A backend fault must fail that batch's callers and keep serving."""
+
+    fail_next = {"on": True}
+
+    async def flush(items):
+        if fail_next.pop("on", False):
+            raise RuntimeError("kernel fault")
+        for item in items:
+            item.future.set_result("ok")
+
+    sched = BatchScheduler(flush, max_batch=4, max_wait=0.005)
+    sched.start()
+    try:
+        from drand_tpu.serve.batcher import BatchItem
+
+        loop = asyncio.get_event_loop()
+        first = BatchItem(payload=None, future=loop.create_future())
+        sched.submit(first)
+        with pytest.raises(RuntimeError, match="kernel fault"):
+            await first.future
+        second = BatchItem(payload=None, future=loop.create_future())
+        sched.submit(second)
+        assert await second.future == "ok"
+    finally:
+        await sched.close()
+
+
+async def test_scheduler_close_fails_queued_items():
+    async def flush(items):
+        await asyncio.sleep(10)
+
+    sched = BatchScheduler(flush, max_wait=0.001)
+    from drand_tpu.serve.batcher import BatchItem
+
+    loop = asyncio.get_event_loop()
+    item = BatchItem(payload=None, future=loop.create_future())
+    sched.submit(item)  # never started: item stays queued
+    await sched.close()
+    with pytest.raises(RuntimeError):
+        await item.future
+    with pytest.raises(RuntimeError):
+        sched.submit(BatchItem(payload=None,
+                               future=loop.create_future()))
+
+
+# -- REST surface -----------------------------------------------------------
+
+
+async def test_rest_verify_endpoint_and_backpressure_mapping():
+    """POST /v1/verify speaks the gateway's failure model: verdicts for
+    a mixed batch, 429 with Retry-After on shed, 400 on garbage."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from drand_tpu.net.rest import build_verify_app
+
+    scheme = StubScheme()
+    async with gateway(scheme) as gw:
+        client = TestClient(TestServer(build_verify_app(gw)))
+        await client.start_server()
+        try:
+            claim = {"round": 9, "previous_round": 8,
+                     "previous": ("01" * 96),
+                     "signature": (b"ok-nine").hex()}
+            resp = await client.post("/v1/verify", json=claim)
+            assert resp.status == 200
+            j = await resp.json()
+            assert j["valid"] and not j["cached"]
+
+            batch = {"items": [
+                claim,
+                {**claim, "round": 10, "signature": (b"no-ten").hex()},
+            ]}
+            resp = await client.post("/v1/verify", json=batch)
+            assert resp.status == 200
+            j = await resp.json()
+            assert [i.get("valid") for i in j["items"]] == [True, False]
+
+            resp = await client.post("/v1/verify", json={"round": 1})
+            assert resp.status == 400
+
+            metrics = await client.get("/metrics")
+            assert "drand_serve_batch_size" in await metrics.text()
+        finally:
+            await client.close()
+
+
+async def test_rest_verify_returns_429_when_overloaded():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from drand_tpu.net.rest import build_verify_app
+
+    gate = threading.Event()
+    scheme = StubScheme(gate)
+    async with gateway(scheme, max_queue=1) as gw:
+        client = TestClient(TestServer(build_verify_app(gw)))
+        await client.start_server()
+        try:
+            first = asyncio.ensure_future(gw.verify(req(1)))
+            await asyncio.sleep(0.05)  # kernel now blocked on the gate
+            # fill the queue, then the REST call must shed
+            filler = asyncio.ensure_future(gw.verify(req(2)))
+            await asyncio.sleep(0)
+            claim = {"round": 3, "previous_round": 2,
+                     "previous": ("01" * 96),
+                     "signature": (b"ok-three").hex()}
+            resp = await client.post("/v1/verify", json=claim)
+            assert resp.status == 429
+            assert resp.headers.get("Retry-After") == "1"
+            gate.set()
+            assert (await first).valid and (await filler).valid
+        finally:
+            gate.set()
+            await client.close()

@@ -6,10 +6,18 @@ import asyncio
 import pytest
 
 from drand_tpu.key import Identity
+from drand_tpu.net import tls as tls_mod
 from drand_tpu.net.tls import CertManager, generate_self_signed
 from drand_tpu.net.transport import GrpcClient, build_public_server
 
 from test_core import free_ports
+
+# serving pre-generated certs is stdlib-only, but minting self-signed
+# ones needs the optional 'cryptography' package (net/tls.py gates it)
+pytestmark = pytest.mark.skipif(
+    tls_mod.x509 is None,
+    reason="self-signed cert generation needs the 'cryptography' package",
+)
 
 
 class _FakeDaemon:

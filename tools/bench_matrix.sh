@@ -37,6 +37,7 @@ run "vpu e2e b1024"         DRAND_TPU_PALLAS_CONV=vpu
 run "mxu e2e b1024"         DRAND_TPU_PALLAS_CONV=mxu
 run "kara e2e b1024"        DRAND_TPU_PALLAS_CONV=kara
 run "mxu+kara e2e b1024"    DRAND_TPU_PALLAS_CONV=mxu+kara
+run "vpu shared-miller e2e b1024" DRAND_TPU_PALLAS_CONV=vpu DRAND_TPU_MILLER=shared
 run "vpu device-only b1024" DRAND_TPU_PALLAS_CONV=vpu BENCH_DEVICE_ONLY=1
 run "vpu e2e b2048"         DRAND_TPU_PALLAS_CONV=vpu BENCH_BATCH=2048 BENCH_ITERS=2
 run "vpu e2e b4096"         DRAND_TPU_PALLAS_CONV=vpu BENCH_BATCH=4096 BENCH_ITERS=2

@@ -1,0 +1,205 @@
+"""Regenerate drand_tpu/net/drand_tpu_pb2.py without protoc.
+
+The container has `google.protobuf` but no `grpc_tools`/`protoc`, so
+this script rebuilds the serialized FileDescriptorProto from scratch —
+the authoritative schema is net/protos/drand_tpu.proto, and this file
+must be kept in sync with it by hand (field names, numbers, types).
+The emitted module matches protoc's layout: AddSerializedFile + builder
+calls + the pure-python offsets block.
+
+Run:  python tools/gen_proto.py
+"""
+
+from __future__ import annotations
+
+import os
+
+from google.protobuf import descriptor_pb2 as dp
+
+F = dp.FieldDescriptorProto
+
+OUT = os.path.join(os.path.dirname(__file__), os.pardir,
+                   "drand_tpu", "net", "drand_tpu_pb2.py")
+
+
+def field(name, number, ftype, label=F.LABEL_OPTIONAL, type_name=None,
+          oneof_index=None):
+    f = F(name=name, number=number, type=ftype, label=label)
+    if type_name is not None:
+        f.type_name = type_name
+    if oneof_index is not None:
+        f.oneof_index = oneof_index
+    return f
+
+
+def msg(name, *fields, oneofs=()):
+    d = dp.DescriptorProto(name=name)
+    d.field.extend(fields)
+    for o in oneofs:
+        d.oneof_decl.add(name=o)
+    return d
+
+
+def build_file() -> dp.FileDescriptorProto:
+    fd = dp.FileDescriptorProto(
+        name="drand_tpu.proto", package="drandtpu", syntax="proto3"
+    )
+    m = fd.message_type
+    U64, U32, BYT, STR, BOO, DBL = (F.TYPE_UINT64, F.TYPE_UINT32,
+                                    F.TYPE_BYTES, F.TYPE_STRING,
+                                    F.TYPE_BOOL, F.TYPE_DOUBLE)
+    REP = F.LABEL_REPEATED
+
+    # -- public ---------------------------------------------------------
+    m.append(msg("PublicRandRequest", field("round", 1, U64)))
+    m.append(msg("PublicRandResponse",
+                 field("round", 1, U64),
+                 field("previous_round", 2, U64),
+                 field("previous_signature", 3, BYT),
+                 field("signature", 4, BYT),
+                 field("randomness", 5, BYT)))
+    m.append(msg("PrivateRandRequest", field("request", 1, BYT)))
+    m.append(msg("PrivateRandResponse", field("response", 1, BYT)))
+    m.append(msg("GroupRequest"))
+    m.append(msg("GroupResponse", field("group_toml", 1, STR)))
+    m.append(msg("HomeRequest"))
+    m.append(msg("HomeResponse", field("status", 1, STR)))
+
+    # -- protocol -------------------------------------------------------
+    m.append(msg("BeaconPacketMsg",
+                 field("from_address", 1, STR),
+                 field("round", 2, U64),
+                 field("previous_round", 3, U64),
+                 field("previous_signature", 4, BYT),
+                 field("partial_signature", 5, BYT)))
+    m.append(msg("Empty"))
+    m.append(msg("SyncRequest", field("from_round", 1, U64)))
+    m.append(msg("BeaconRecord",
+                 field("round", 1, U64),
+                 field("previous_round", 2, U64),
+                 field("previous_signature", 3, BYT),
+                 field("signature", 4, BYT)))
+    m.append(msg("DealMsg",
+                 field("dealer_index", 1, U32),
+                 field("recipient_index", 2, U32),
+                 field("commits", 3, BYT, REP),
+                 field("encrypted_share", 4, BYT),
+                 field("signature", 5, BYT)))
+    m.append(msg("ResponseMsg",
+                 field("dealer_index", 1, U32),
+                 field("verifier_index", 2, U32),
+                 field("approved", 3, BOO),
+                 field("signature", 4, BYT)))
+    m.append(msg("JustificationMsg",
+                 field("dealer_index", 1, U32),
+                 field("verifier_index", 2, U32),
+                 field("share_value", 3, BYT),
+                 field("commits", 4, BYT, REP),
+                 field("signature", 5, BYT)))
+    m.append(msg("DKGPacketMsg",
+                 field("group_hash", 2, BYT),
+                 field("deal", 3, F.TYPE_MESSAGE,
+                       type_name=".drandtpu.DealMsg", oneof_index=0),
+                 field("response", 4, F.TYPE_MESSAGE,
+                       type_name=".drandtpu.ResponseMsg", oneof_index=0),
+                 field("justification", 5, F.TYPE_MESSAGE,
+                       type_name=".drandtpu.JustificationMsg",
+                       oneof_index=0),
+                 oneofs=("body",)))
+
+    # -- verify (serve/ gateway) ---------------------------------------
+    m.append(msg("VerifyBeaconRequest",
+                 field("round", 1, U64),
+                 field("previous_round", 2, U64),
+                 field("previous_signature", 3, BYT),
+                 field("signature", 4, BYT),
+                 field("timeout_seconds", 5, DBL)))
+    m.append(msg("VerifyBeaconResponse",
+                 field("valid", 1, BOO),
+                 field("cached", 2, BOO),
+                 field("batch_size", 3, U32),
+                 field("error", 4, STR)))
+    m.append(msg("VerifyBeaconBatchRequest",
+                 field("items", 1, F.TYPE_MESSAGE, REP,
+                       type_name=".drandtpu.VerifyBeaconRequest"),
+                 field("timeout_seconds", 2, DBL)))
+    m.append(msg("VerifyBeaconBatchResponse",
+                 field("items", 1, F.TYPE_MESSAGE, REP,
+                       type_name=".drandtpu.VerifyBeaconResponse")))
+
+    # -- control --------------------------------------------------------
+    m.append(msg("PingRequest"))
+    m.append(msg("PingResponse"))
+    m.append(msg("InitDKGRequest",
+                 field("group_toml", 1, STR),
+                 field("is_leader", 2, BOO),
+                 field("timeout_seconds", 3, DBL),
+                 field("entropy", 4, BYT)))
+    m.append(msg("InitReshareRequest",
+                 field("old_group_toml", 1, STR),
+                 field("new_group_toml", 2, STR),
+                 field("is_leader", 3, BOO),
+                 field("timeout_seconds", 4, DBL),
+                 field("entropy", 5, BYT)))
+    m.append(msg("InitResponse", field("dist_key_hex", 1, STR)))
+    m.append(msg("ShareRequest"))
+    m.append(msg("ShareResponse",
+                 field("index", 1, U32),
+                 field("share_hex", 2, STR)))
+    m.append(msg("KeyRequest"))
+    m.append(msg("KeyResponse", field("key_hex", 1, STR)))
+    m.append(msg("CollectiveKeyResponse",
+                 field("coefficients_hex", 1, STR, REP)))
+    m.append(msg("GroupFileRequest"))
+    m.append(msg("ShutdownRequest"))
+    m.append(msg("ShutdownResponse"))
+    return fd
+
+
+HEADER = '''# -*- coding: utf-8 -*-
+# Generated by tools/gen_proto.py (no protoc in the toolchain).
+# Schema source of truth: drand_tpu/net/protos/drand_tpu.proto
+"""Generated protocol buffer code."""
+from google.protobuf.internal import builder as _builder
+from google.protobuf import descriptor as _descriptor
+from google.protobuf import descriptor_pool as _descriptor_pool
+from google.protobuf import symbol_database as _symbol_database
+# @@protoc_insertion_point(imports)
+
+_sym_db = _symbol_database.Default()
+
+
+
+
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile({blob!r})
+
+_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
+_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'drand_tpu_pb2', globals())
+if _descriptor._USE_C_DESCRIPTORS == False:
+
+  DESCRIPTOR._options = None
+{offsets}# @@protoc_insertion_point(module_scope)
+'''
+
+
+def main() -> None:
+    fd = build_file()
+    blob = fd.SerializeToString()
+    offsets = []
+    for m in fd.message_type:
+        sub = m.SerializeToString()
+        start = blob.find(sub)
+        assert start >= 0, m.name
+        offsets.append(f"  _{m.name.upper()}._serialized_start={start}\n"
+                       f"  _{m.name.upper()}._serialized_end="
+                       f"{start + len(sub)}\n")
+    out = HEADER.format(blob=blob, offsets="".join(offsets))
+    with open(OUT, "w") as fh:
+        fh.write(out)
+    print(f"wrote {os.path.normpath(OUT)} "
+          f"({len(fd.message_type)} messages, {len(blob)} descriptor "
+          f"bytes)")
+
+
+if __name__ == "__main__":
+    main()
