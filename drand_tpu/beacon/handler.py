@@ -40,6 +40,7 @@ from drand_tpu.beacon.round_cache import RoundManager
 from drand_tpu.beacon.store import BeaconStore, CallbackStore
 from drand_tpu.crypto import tbls
 from drand_tpu.key import Group, Identity, Share
+from drand_tpu.obs import trace as obs_trace
 from drand_tpu.utils import metrics
 from drand_tpu.utils.clock import Clock
 from drand_tpu.utils.logging import get_logger
@@ -86,6 +87,10 @@ class BeaconPacket:
     prev_round: int
     prev_sig: bytes
     partial_sig: bytes
+    #: distributed-trace id of the round this partial belongs to; every
+    #: group member derives the same value, but carrying it on the wire
+    #: lets out-of-group observers stitch too (and survives seed drift)
+    trace_id: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -94,6 +99,7 @@ class BeaconPacket:
             "prev_round": self.prev_round,
             "prev_sig": self.prev_sig.hex(),
             "partial_sig": self.partial_sig.hex(),
+            "trace_id": self.trace_id,
         }
 
     @classmethod
@@ -104,6 +110,7 @@ class BeaconPacket:
             prev_round=int(d["prev_round"]),
             prev_sig=bytes.fromhex(d["prev_sig"]),
             partial_sig=bytes.fromhex(d["partial_sig"]),
+            trace_id=d.get("trace_id", ""),
         )
 
 
@@ -146,6 +153,9 @@ class BeaconHandler:
         self.pub_poly = cfg.share.pub_poly()
         self.dist_key = cfg.share.public().key()
         self.manager = RoundManager(self.scheme.index_of)
+        #: peer address -> clock time of last VALID partial (liveness
+        #: view for /v1/status; never pruned — group size is small)
+        self.peer_seen: Dict[str, float] = {}
         self._running = False
         self._stop_at: Optional[int] = None
         self._loop_task: Optional[asyncio.Task] = None
@@ -254,6 +264,20 @@ class BeaconHandler:
         head = self.store.last()
         if head is None or head.round >= round:
             return
+        # every group member derives the same trace id for this round, so
+        # the per-node span trees stitch into one distributed trace
+        tid = obs_trace.round_trace_id(
+            self.group.get_genesis_seed(), round
+        ) if obs_trace.TRACER.enabled else ""
+        with obs_trace.TRACER.span(
+            "beacon.round", trace_id=tid or None,
+            attrs={"round": round, "node": self.cfg.public.address},
+        ) as round_span:
+            await self._run_round_traced(round, head, t_start, tid)
+            round_span.set_attr("head", round)
+
+    async def _run_round_traced(self, round: int, head: Beacon,
+                                t_start: float, tid: str) -> None:
         prev_round, prev_sig = head.round, head.signature
         msg = beacon_message(prev_sig, prev_round, round)
         # sign OFF the event loop (reference: the round goroutine,
@@ -262,9 +286,12 @@ class BeaconHandler:
         # starves itself: each node's inbound partials only get CPU
         # after the next tick's signs, so every round is abandoned with
         # its partials still queued behind the loop.
-        own = await asyncio.to_thread(
-            self.scheme.partial_sign, self.cfg.share.share, msg
-        )
+        # (asyncio.to_thread copies the contextvars context, so kernel
+        # spans opened inside the scheme parent to the stage span.)
+        with obs_trace.TRACER.span("beacon.sign", attrs={"round": round}):
+            own = await asyncio.to_thread(
+                self.scheme.partial_sign, self.cfg.share.share, msg
+            )
         queue = self.manager.new_round(round, prev_round, prev_sig)
         self.manager.add_partial(round, own, prev_round, prev_sig)
         packet = BeaconPacket(
@@ -273,35 +300,49 @@ class BeaconHandler:
             prev_round=prev_round,
             prev_sig=prev_sig,
             partial_sig=own,
+            trace_id=tid,
         )
-        for node in self.group.nodes:
-            if node.address == self.cfg.public.address:
-                continue
-            asyncio.create_task(self._send_packet(node, packet))
+        with obs_trace.TRACER.span(
+            "beacon.gossip",
+            attrs={"round": round, "peers": len(self.group) - 1},
+        ):
+            for node in self.group.nodes:
+                if node.address == self.cfg.public.address:
+                    continue
+                asyncio.create_task(self._send_packet(node, packet))
 
-        partials: Dict[int, bytes] = {self.index: own}
-        while len(partials) < self.group.threshold:
-            # the manager only queues partials matching our chain link
-            # (mismatches don't consume the signer's dedup slot)
-            blob, _, _ = await queue.get()
-            partials[self.scheme.index_of(blob)] = blob
+        with obs_trace.TRACER.span(
+            "beacon.aggregate",
+            attrs={"round": round, "threshold": self.group.threshold},
+        ) as agg_span:
+            partials: Dict[int, bytes] = {self.index: own}
+            while len(partials) < self.group.threshold:
+                # the manager only queues partials matching our chain link
+                # (mismatches don't consume the signer's dedup slot)
+                blob, _, _ = await queue.get()
+                partials[self.scheme.index_of(blob)] = blob
+            agg_span.set_attr("partials", len(partials))
 
-        sig = await asyncio.to_thread(
-            self.scheme.recover,
-            self.pub_poly, msg, list(partials.values()),
-            self.group.threshold, len(self.group),
-        )
+            sig = await asyncio.to_thread(
+                self.scheme.recover,
+                self.pub_poly, msg, list(partials.values()),
+                self.group.threshold, len(self.group),
+            )
         beacon = Beacon(round=round, prev_round=prev_round,
                         prev_sig=prev_sig, signature=sig)
-        await asyncio.to_thread(
-            verify_beacon, self.scheme, self.dist_key, beacon
-        )
+        with obs_trace.TRACER.span("beacon.verify",
+                                   attrs={"round": round}):
+            await asyncio.to_thread(
+                verify_beacon, self.scheme, self.dist_key, beacon
+            )
         # the head may have advanced while we were collecting — a benign
         # sync race, not a failure (the chain moved on without us)
         cur_head = self.store.last()
         if cur_head is not None and cur_head.round >= round:
             return
-        self.store.put(beacon)
+        with obs_trace.TRACER.span("beacon.store",
+                                   attrs={"round": round}):
+            self.store.put(beacon)
         _rounds_total.inc()
         _head_gauge.set(round)
         _round_seconds.observe(
@@ -340,19 +381,32 @@ class BeaconHandler:
 
     async def process_beacon(self, packet: BeaconPacket) -> None:
         """Inbound partial signature (reference ProcessBeacon :124-160)."""
-        try:
-            self.check_packet_window(packet)
-            msg = beacon_message(packet.prev_sig, packet.prev_round,
-                                 packet.round)
-            # heavy pairing math runs off the event loop so the gRPC
-            # server keeps answering during verification
-            await asyncio.to_thread(
-                self.scheme.verify_partial, self.pub_poly, msg,
-                packet.partial_sig,
+        # join the sender's round trace: prefer the propagated id, else
+        # re-derive it (both sides compute the same value)
+        tid = None
+        if obs_trace.TRACER.enabled:
+            tid = packet.trace_id or obs_trace.round_trace_id(
+                self.group.get_genesis_seed(), packet.round
             )
-        except Exception:
-            _partials_rejected.inc()
-            raise
+        with obs_trace.TRACER.span(
+            "beacon.partial_verify", trace_id=tid,
+            attrs={"round": packet.round, "from": packet.from_address,
+                   "node": self.cfg.public.address},
+        ):
+            try:
+                self.check_packet_window(packet)
+                msg = beacon_message(packet.prev_sig, packet.prev_round,
+                                     packet.round)
+                # heavy pairing math runs off the event loop so the gRPC
+                # server keeps answering during verification
+                await asyncio.to_thread(
+                    self.scheme.verify_partial, self.pub_poly, msg,
+                    packet.partial_sig,
+                )
+            except Exception:
+                _partials_rejected.inc()
+                raise
+        self.peer_seen[packet.from_address] = self.clock.now()
         # a valid partial referencing a chain link AHEAD of our head means
         # we missed a round: pull the gap from peers (the reference's
         # recovery is pull-based catch-up, SURVEY §5) so the next round's

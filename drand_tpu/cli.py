@@ -18,6 +18,8 @@ and daemon.go/control.go/public.go):
   drand-tpu ping                           control-port liveness
   drand-tpu show share|group|public|private|cokey
   drand-tpu reset                          wipe beacon + share state
+  drand-tpu status                         health snapshot (/v1/status)
+  drand-tpu trace <round>                  span tree of one beacon round
 
 Run as `python -m drand_tpu.cli ...`.
 """
@@ -130,8 +132,18 @@ def _load_certs_dir(cert_manager, certs_dir) -> int:
 
 
 def cmd_start(args) -> int:
+    import signal
+
     from drand_tpu.core import Config, Drand
     from drand_tpu.crypto import tbls
+    from drand_tpu.obs import flight, install_crash_handler
+
+    # post-mortem evidence next to the keys: an unhandled exception (and
+    # SIGTERM below) dumps the flight-recorder ring buffer before exit
+    dump_path = os.path.join(
+        os.path.expanduser(args.folder), "flight_dump.json"
+    )
+    install_crash_handler(dump_path)
 
     async def run():
         store = _store(args)
@@ -168,6 +180,17 @@ def cmd_start(args) -> int:
             daemon = await Drand.new(cfg, pair)
             print("fresh node: waiting for DKG "
                   f"(control port {args.control})")
+
+        def _graceful(signame: str) -> None:
+            flight.RECORDER.record("signal", signal=signame)
+            asyncio.ensure_future(daemon.stop())
+
+        loop = asyncio.get_running_loop()
+        for s in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(s, _graceful, s.name)
+            except NotImplementedError:
+                pass
         await daemon.wait_exit()
 
     asyncio.run(run())
@@ -464,6 +487,74 @@ def cmd_reset(args) -> int:
     return 0
 
 
+def _http_get_json(url: str):
+    import json
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _print_kv(d: dict, indent: int = 0) -> None:
+    for k in sorted(d):
+        v = d[k]
+        if isinstance(v, dict):
+            print(f"{'  ' * indent}{k}:")
+            _print_kv(v, indent + 1)
+        else:
+            print(f"{'  ' * indent}{k}: {v}")
+
+
+def cmd_status(args) -> int:
+    import json
+
+    st = _http_get_json(f"{args.url.rstrip('/')}/v1/status")
+    if args.json:
+        print(json.dumps(st, indent=2, sort_keys=True))
+    else:
+        _print_kv(st)
+    return 0
+
+
+def _print_span_tree(spans) -> None:
+    """Indent spans under their parents; a span whose parent is not in
+    this trace (evicted, or recorded on another node) prints as a root."""
+    ids = {s["span_id"] for s in spans}
+    children: dict = {}
+    for s in spans:
+        parent = s.get("parent_id")
+        children.setdefault(parent if parent in ids else None,
+                            []).append(s)
+
+    def walk(parent, depth):
+        for s in sorted(children.get(parent, []),
+                        key=lambda s: s["start"]):
+            dur = s.get("duration")
+            ms = "       ?" if dur is None else f"{dur * 1e3:8.2f}ms"
+            attrs = " ".join(
+                f"{k}={v}" for k, v in sorted(s.get("attrs", {}).items())
+            )
+            err = "" if s.get("status") == "ok" else f"  [{s['status']}]"
+            print(f"  {ms}  {'  ' * depth}{s['name']}"
+                  f"{'  ' + attrs if attrs else ''}{err}")
+            walk(s["span_id"], depth + 1)
+
+    walk(None, 0)
+
+
+def cmd_trace(args) -> int:
+    base = args.url.rstrip("/")
+    data = _http_get_json(f"{base}/debug/traces?round={args.round}")
+    traces = data.get("traces", [])
+    if not traces:
+        print(f"no trace recorded for round {args.round}")
+        return 1
+    for t in traces:
+        print(f"trace {t['trace_id']} ({len(t['spans'])} spans)")
+        _print_span_tree(t["spans"])
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="drand-tpu",
@@ -589,6 +680,24 @@ def build_parser() -> argparse.ArgumentParser:
 
     g = sub.add_parser("reset")
     g.set_defaults(fn=cmd_reset)
+
+    g = sub.add_parser(
+        "status", help="daemon health snapshot (GET /v1/status)"
+    )
+    g.add_argument("--url", default="http://127.0.0.1:8080",
+                   help="REST base URL of the node")
+    g.add_argument("--json", action="store_true",
+                   help="print the raw JSON document")
+    g.set_defaults(fn=cmd_status)
+
+    g = sub.add_parser(
+        "trace",
+        help="span tree of one beacon round (GET /debug/traces?round=N)",
+    )
+    g.add_argument("round", type=int)
+    g.add_argument("--url", default="http://127.0.0.1:8080",
+                   help="REST base URL of the node")
+    g.set_defaults(fn=cmd_trace)
     return p
 
 

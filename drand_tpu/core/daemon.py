@@ -266,7 +266,29 @@ class Drand:
             await self._verify_gateway.start()
         return self._verify_gateway
 
+    def status_json(self) -> dict:
+        """The /v1/status health document (obs/introspect.py)."""
+        from drand_tpu.obs.introspect import daemon_status
+
+        return daemon_status(self)
+
+    def _dump_flight(self) -> None:
+        """Best-effort flight-recorder dump into the daemon folder, so a
+        crash or SIGTERM leaves post-mortem evidence next to the keys."""
+        if self.cfg.in_memory:
+            return
+        from drand_tpu.obs import flight
+
+        try:
+            base = os.path.expanduser(self.cfg.base_folder)
+            flight.RECORDER.dump_to(
+                os.path.join(base, "flight_dump.json")
+            )
+        except Exception as exc:
+            log.debug("flight dump failed", err=exc)
+
     async def stop(self) -> None:
+        self._dump_flight()
         if self.beacon is not None:
             await self.beacon.stop()
         if self._verify_gateway is not None:

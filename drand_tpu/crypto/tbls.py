@@ -30,19 +30,13 @@ from drand_tpu.crypto.poly import (
     PubPoly,
     lagrange_basis_at_zero,
 )
-from drand_tpu.utils import metrics
+# kernel_span wraps every device dispatch: same per-op
+# drand_device_kernel_seconds histograms as before, plus trace spans
+# (parented to the calling round/batch) and flight-recorder events
+from drand_tpu.obs.kernels import kernel_span
 
 INDEX_LEN = 2
 SIG_LEN = 96
-
-_kernel_seconds = {
-    op: metrics.histogram(
-        "drand_device_kernel_seconds",
-        "wall time of device crypto kernel dispatches",
-        labels={"op": op},
-    )
-    for op in ("pairing_check", "msm_recover", "g2_sign")
-}
 
 
 class ThresholdError(Exception):
@@ -254,7 +248,7 @@ class NativeScheme(Scheme):
     # -- single-op protocol-plane API -------------------------------------
 
     def partial_sign(self, share: PriShare, msg: bytes) -> bytes:
-        with _kernel_seconds["g2_sign"].time():
+        with kernel_span("g2_sign", backend="native", batch=1):
             sig = self._nb.sign(msg, share.value)
         return share.index.to_bytes(INDEX_LEN, "big") + sig
 
@@ -270,7 +264,7 @@ class NativeScheme(Scheme):
         if sig == self._IDENT96:
             raise ThresholdError("identity signature rejected")
         pk_i = self._eval_pub(pub, idx)
-        with _kernel_seconds["pairing_check"].time():
+        with kernel_span("pairing_check", backend="native", batch=1):
             rc = self._nb.verify(pk_i, msg, sig)
         if rc != 1:
             raise ThresholdError(f"invalid partial signature from {idx}")
@@ -296,7 +290,8 @@ class NativeScheme(Scheme):
             )
         chosen = sorted(seen.items())[:t]
         lam = lagrange_basis_at_zero([i for i, _ in chosen])
-        with _kernel_seconds["msm_recover"].time():
+        with kernel_span("msm_recover", backend="native",
+                         batch=len(chosen)):
             return self._nb.g2_msm(
                 [sig for _, sig in chosen],
                 [lam[i] for i, _ in chosen],
@@ -308,7 +303,7 @@ class NativeScheme(Scheme):
         if sb == self._IDENT96:
             raise ThresholdError("identity signature rejected")
         pk = ref.g1_to_bytes(pub_key)
-        with _kernel_seconds["pairing_check"].time():
+        with kernel_span("pairing_check", backend="native", batch=1):
             rc = self._nb.verify(pk, msg, sb)
         if rc != 1:
             raise ThresholdError("invalid recovered signature")
@@ -319,7 +314,8 @@ class NativeScheme(Scheme):
                               partials: Sequence[bytes]) -> List[bool]:
         hm = self._nb.hash_to_g2(msg)  # hash once for the whole flood
         out = []
-        with _kernel_seconds["pairing_check"].time():
+        with kernel_span("pairing_check", backend="native",
+                         batch=len(partials)):
             for blob in partials:
                 if len(blob) != INDEX_LEN + SIG_LEN:
                     out.append(False)
@@ -340,7 +336,8 @@ class NativeScheme(Scheme):
     def verify_chain_batch(self, pub_key, msgs, sigs):
         pk = ref.g1_to_bytes(pub_key)
         out = []
-        with _kernel_seconds["pairing_check"].time():
+        with kernel_span("pairing_check", backend="native",
+                         batch=len(msgs)):
             for msg, sig in zip(msgs, sigs):
                 try:
                     sb = self._sig_bytes(sig)
@@ -429,10 +426,13 @@ class JaxScheme(Scheme):
             # pad to the kernel block on the HOST (cheap SHA) so every
             # batch <= 128 presents the same jit shape
             n = len(msgs)
-            padded = list(msgs) + [msgs[0]] * ((-n) % 128)
-            u0, u1 = self._h2c.hash_to_field_device(padded)
-            return self._hash_pallas(u0, u1)[:n]
-        return self._h2c.hash_to_g2_batch(msgs)
+            with kernel_span("h2c", backend="jax", batch=n,
+                             padded=n + ((-n) % 128)):
+                padded = list(msgs) + [msgs[0]] * ((-n) % 128)
+                u0, u1 = self._h2c.hash_to_field_device(padded)
+                return self._hash_pallas(u0, u1)[:n]
+        with kernel_span("h2c", backend="jax", batch=len(msgs)):
+            return self._h2c.hash_to_g2_batch(msgs)
 
     def _hash_msgs_proj(self, msgs):
         """Same, projective (B, 3, 2, L) for scalar-mult consumers."""
@@ -445,7 +445,7 @@ class JaxScheme(Scheme):
     # -- single-op API (device scalar mult / single pairing check) -------
 
     def partial_sign(self, share: PriShare, msg: bytes) -> bytes:
-        with _kernel_seconds["g2_sign"].time():
+        with kernel_span("g2_sign", backend="jax", batch=1):
             # H(m) on device too (reference: Sign includes hash-to-curve,
             # /root/reference/beacon/beacon.go:433)
             hq = self._hash_msgs_proj([msg])[0]
@@ -474,7 +474,8 @@ class JaxScheme(Scheme):
                 [self._curve.scalar_to_bits(lam[i]) for i, _ in chosen]
             )
         )
-        with _kernel_seconds["msm_recover"].time():
+        with kernel_span("msm_recover", backend="jax",
+                         batch=len(chosen)):
             acc = self._msm.g2_msm(pts, bits)
             out = self._curve.g2_decode(acc)
         return ref.g2_to_bytes(out)
@@ -515,7 +516,8 @@ class JaxScheme(Scheme):
         p2 = self._curve.g1_affine_encode_batch([pks[i] for i in rows])
         h1 = self._hash_msgs([msg])             # (1, 2, 2, L) on device
         q2 = self._jnp.broadcast_to(h1[0], (nb, *h1.shape[1:]))
-        with _kernel_seconds["pairing_check"].time():
+        with kernel_span("pairing_check", backend="jax",
+                         batch=len(live), padded=nb):
             ok = np.asarray(self._check(p1, q1, p2, q2))
         out = [False] * len(partials)
         for j, i in enumerate(live):
@@ -548,7 +550,8 @@ class JaxScheme(Scheme):
         # messages hashed on device, batched (round 1 paid 0.6 s of host
         # Python per row here — the whole point of ops/h2c.py)
         row_msgs = [msgs[i] for i in rows]
-        with _kernel_seconds["pairing_check"].time():
+        with kernel_span("pairing_check", backend="jax",
+                         batch=len(live), padded=nb):
             if self._check_hashed is not None:
                 u0, u1 = self._h2c.hash_to_field_device(row_msgs)
                 ok = np.asarray(self._check_hashed(p1, q1, p2, u0, u1))

@@ -26,6 +26,7 @@ from drand_tpu.dkg.pedersen import (
     Response,
 )
 from drand_tpu.key import Group, Identity, Pair, Share
+from drand_tpu.obs import trace as obs_trace
 from drand_tpu.utils.clock import Clock
 
 from drand_tpu.utils.logging import get_logger
@@ -74,6 +75,12 @@ class DKGHandler:
             # message from one DKG run cannot be replayed into another
             session_id=cfg.new_group.hash(),
         )
+        # one distributed trace per DKG run: the id derives from the
+        # session id (new-group hash), so all participants stitch
+        self._trace_id = (
+            obs_trace.dkg_trace_id(cfg.new_group.hash())
+            if obs_trace.TRACER.enabled else None
+        )
         self._sent_deals = False
         self._done = False
         self._share_fut: asyncio.Future = (
@@ -81,6 +88,13 @@ class DKGHandler:
         )
         self._timer_task: Optional[asyncio.Task] = None
         self._lock = asyncio.Lock()
+
+    def _span(self, name: str, **attrs):
+        """Per-phase span inside this DKG run's distributed trace."""
+        attrs.setdefault("addr", self.cfg.pair.public.address)
+        return obs_trace.TRACER.span(
+            name, trace_id=self._trace_id, attrs=attrs
+        )
 
     # -- control ----------------------------------------------------------
 
@@ -99,16 +113,18 @@ class DKGHandler:
             if self._sent_deals or not self.dkg.is_dealer:
                 return
             self._sent_deals = True
-        deals = self.dkg.deals()
-        for deal in deals:
-            target = self.cfg.new_group.nodes[deal.recipient_index]
-            if self._is_self(target):
-                resp = self.dkg.process_deal(deal)
-                await self._broadcast_response(resp)
-            else:
-                await self._send(
-                    target, {"dkg_deal": deal.to_dict()}
-                )
+        with self._span("dkg.deal_out") as span:
+            deals = self.dkg.deals()
+            span.set_attr("deals", len(deals))
+            for deal in deals:
+                target = self.cfg.new_group.nodes[deal.recipient_index]
+                if self._is_self(target):
+                    resp = self.dkg.process_deal(deal)
+                    await self._broadcast_response(resp)
+                else:
+                    await self._send(
+                        target, {"dkg_deal": deal.to_dict()}
+                    )
 
     async def _broadcast_response(self, resp: Response) -> None:
         packet = {"dkg_response": resp.to_dict()}
@@ -158,35 +174,40 @@ class DKGHandler:
         self._arm_timer()
         await self._send_deals()
         if "dkg_deal" in packet:
-            deal = Deal.from_dict(packet["dkg_deal"])
-            try:
-                resp = self.dkg.process_deal(deal)
-            except DKGError as exc:
-                log.warning("bad deal", err=exc)
-                return
-            await self._broadcast_response(resp)
+            with self._span("dkg.deal"):
+                deal = Deal.from_dict(packet["dkg_deal"])
+                try:
+                    resp = self.dkg.process_deal(deal)
+                except DKGError as exc:
+                    log.warning("bad deal", err=exc)
+                    return
+                await self._broadcast_response(resp)
         elif "dkg_response" in packet:
-            try:
-                self.dkg.process_response(
-                    Response.from_dict(packet["dkg_response"])
-                )
-            except DKGError as exc:
-                log.warning("bad response", err=exc)
-                return
-            # a complaint against OUR dealing: answer it publicly by
-            # revealing the disputed sub-share (kyber justification,
-            # vss.proto:60-69) so a false complaint cannot exclude us
-            await self._broadcast_justifications()
-            self._check_done()
+            with self._span("dkg.response"):
+                try:
+                    self.dkg.process_response(
+                        Response.from_dict(packet["dkg_response"])
+                    )
+                except DKGError as exc:
+                    log.warning("bad response", err=exc)
+                    return
+                # a complaint against OUR dealing: answer it publicly by
+                # revealing the disputed sub-share (kyber justification,
+                # vss.proto:60-69) so a false complaint cannot exclude us
+                await self._broadcast_justifications()
+                self._check_done()
         elif "dkg_justification" in packet:
-            try:
-                self.dkg.process_justification(
-                    Justification.from_dict(packet["dkg_justification"])
-                )
-            except DKGError as exc:
-                log.warning("bad justification", err=exc)
-                return
-            self._check_done()
+            with self._span("dkg.justification"):
+                try:
+                    self.dkg.process_justification(
+                        Justification.from_dict(
+                            packet["dkg_justification"]
+                        )
+                    )
+                except DKGError as exc:
+                    log.warning("bad justification", err=exc)
+                    return
+                self._check_done()
 
     async def _broadcast_justifications(self) -> None:
         for complaint in self.dkg.pending_complaints():
@@ -231,16 +252,19 @@ class DKGHandler:
         self._done = True
         if self._timer_task is not None:
             self._timer_task.cancel()
-        try:
-            if self.dkg.index is None:
-                # old-only node in a reshare: participates as dealer but
-                # gets no share in the new group
-                result = None
-            else:
-                result = self.dkg.dist_key_share()
-        except DKGError as exc:
-            self._fail(exc)
-            return
+        with self._span("dkg.finalize") as span:
+            try:
+                if self.dkg.index is None:
+                    # old-only node in a reshare: participates as dealer
+                    # but gets no share in the new group
+                    result = None
+                else:
+                    result = self.dkg.dist_key_share()
+            except DKGError as exc:
+                span.set_attr("error", repr(exc))
+                self._fail(exc)
+                return
+            span.set_attr("has_share", result is not None)
         if not self._share_fut.done():
             self._share_fut.set_result(result)
 

@@ -112,12 +112,19 @@ async def handle_verify(gateway, request):
         except (TypeError, ValueError):
             raise web.HTTPBadRequest(text="timeout must be a number")
 
+    # caller identity for per-client metrics: explicit header first,
+    # socket peer otherwise; trace header joins a distributed trace
+    client = request.headers.get("X-Client-Id") or request.remote
+    trace_id = request.headers.get("X-Trace-Id", "")
+
     if "items" in body:
         reqs = [_parse_verify_claim(j) for j in body["items"]]
-        results = await gateway.verify_many(reqs, timeout)
+        results = await gateway.verify_many(reqs, timeout, client=client)
         items = []
         for res in results:
-            if isinstance(res, serve.Overloaded):
+            if isinstance(res, serve.Oversize):
+                items.append({"error": "oversize"})
+            elif isinstance(res, serve.Overloaded):
                 items.append({"error": "overloaded"})
             elif isinstance(res, serve.DeadlineExceeded):
                 items.append({"error": "deadline exceeded"})
@@ -129,7 +136,12 @@ async def handle_verify(gateway, request):
 
     req = _parse_verify_claim(body)
     try:
-        res = await gateway.verify(req, timeout)
+        res = await gateway.verify(req, timeout, client=client,
+                                   trace_id=trace_id or None)
+    except serve.Oversize as exc:
+        raise web.HTTPRequestEntityTooLarge(
+            max_size=exc.limit, actual_size=exc.actual, text=str(exc)
+        )
     except serve.Overloaded as exc:
         raise web.HTTPTooManyRequests(
             text=str(exc), headers={"Retry-After": "1"}
@@ -141,9 +153,43 @@ async def handle_verify(gateway, request):
     return web.json_response(_verify_result_json(res))
 
 
+def _add_obs_routes(routes: web.RouteTableDef, status_fn) -> None:
+    """Introspection surface shared by both apps: health JSON, recent
+    traces, and the live flight-recorder buffer."""
+    from drand_tpu.obs import flight, trace
+
+    @routes.get("/v1/status")
+    async def status(request):
+        return web.json_response(status_fn())
+
+    @routes.get("/debug/traces")
+    async def traces(request):
+        if "round" in request.query:
+            try:
+                rnd = int(request.query["round"])
+            except ValueError:
+                raise web.HTTPBadRequest(text="round must be an integer")
+            return web.json_response(
+                {"traces": trace.TRACER.find_round(rnd)}
+            )
+        try:
+            limit = int(request.query.get("limit", "20"))
+        except ValueError:
+            raise web.HTTPBadRequest(text="limit must be an integer")
+        return web.json_response(
+            {"traces": trace.TRACER.recent(limit)}
+        )
+
+    @routes.get("/debug/flight")
+    async def flight_dump(request):
+        return web.Response(text=flight.RECORDER.dump(),
+                            content_type="application/json")
+
+
 def build_verify_app(gateway) -> web.Application:
     """Standalone verification-gateway app (`cli.py verify-serve`): just
-    /v1/verify, /metrics and a status page — no daemon behind it."""
+    /v1/verify, /metrics, the obs surface and a status page — no daemon
+    behind it."""
     routes = web.RouteTableDef()
 
     @routes.get("/")
@@ -164,6 +210,8 @@ def build_verify_app(gateway) -> web.Application:
 
         return web.Response(text=metrics.render(),
                             content_type="text/plain", charset="utf-8")
+
+    _add_obs_routes(routes, gateway.stats)
 
     app = web.Application()
     app.add_routes(routes)
@@ -253,6 +301,19 @@ def build_rest_app(daemon) -> web.Application:
     async def dashboard(request):
         return web.Response(text=_DASHBOARD_HTML,
                             content_type="text/html", charset="utf-8")
+
+    def _status() -> dict:
+        # daemon is duck-typed here (test stubs, partially-booted
+        # daemons): fall back to the introspector, which guards every
+        # attribute itself
+        fn = getattr(daemon, "status_json", None)
+        if fn is not None:
+            return fn()
+        from drand_tpu.obs.introspect import daemon_status
+
+        return daemon_status(daemon)
+
+    _add_obs_routes(routes, _status)
 
     app = web.Application()
     app.add_routes(routes)

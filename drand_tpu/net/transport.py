@@ -110,12 +110,19 @@ def build_public_server(daemon, address: str,
         return pb.HomeResponse(status=daemon.home_status())
 
     async def new_beacon(request, context):
+        # trace propagation: proto field first, gRPC metadata fallback
+        # (an out-of-tree relay may only set the header)
+        trace_id = request.trace_id
+        if not trace_id:
+            md = dict(context.invocation_metadata() or ())
+            trace_id = md.get("x-drand-trace-id", "")
         packet = BeaconPacket(
             from_address=request.from_address,
             round=request.round,
             prev_round=request.previous_round,
             prev_sig=request.previous_signature,
             partial_sig=request.partial_signature,
+            trace_id=trace_id,
         )
         try:
             await daemon.process_beacon_packet(packet)
@@ -150,7 +157,15 @@ def build_public_server(daemon, address: str,
             signature=request.signature,
         )
         try:
-            res = await gw.verify(req, request.timeout_seconds or None)
+            res = await gw.verify(
+                req, request.timeout_seconds or None,
+                client=context.peer(),
+                trace_id=request.trace_id or None,
+            )
+        except serve.Oversize as exc:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, str(exc)
+            )
         except serve.Overloaded as exc:
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc)
@@ -179,11 +194,13 @@ def build_public_server(daemon, address: str,
             for item in request.items
         ]
         results = await gw.verify_many(
-            reqs, request.timeout_seconds or None
+            reqs, request.timeout_seconds or None, client=context.peer()
         )
         out = []
         for res in results:
-            if isinstance(res, serve.Overloaded):
+            if isinstance(res, serve.Oversize):
+                out.append(pb.VerifyBeaconResponse(error="oversize"))
+            elif isinstance(res, serve.Overloaded):
                 out.append(pb.VerifyBeaconResponse(error="overloaded"))
             elif isinstance(res, serve.DeadlineExceeded):
                 out.append(
@@ -483,16 +500,22 @@ class GrpcClient(ProtocolClient):
             previous_round=packet.prev_round,
             previous_signature=packet.prev_sig,
             partial_signature=packet.partial_sig,
+            trace_id=packet.trace_id,
         )
+        # the trace id rides BOTH the proto field and gRPC metadata, so
+        # middleboxes that only read headers can still stitch the round
+        kwargs = {"timeout": RPC_TIMEOUT}
+        if packet.trace_id:
+            kwargs["metadata"] = (("x-drand-trace-id", packet.trace_id),)
         try:
-            await call(msg, timeout=RPC_TIMEOUT)
+            await call(msg, **kwargs)
         except grpc.aio.AioRpcError as exc:
             if exc.code() == grpc.StatusCode.INVALID_ARGUMENT:
                 raise  # peer rejected the partial — no point retrying
             # retry once (reference net/client_grpc.go:200-206): the peer
             # may have been busy past the deadline
             await asyncio.sleep(0.2)
-            await call(msg, timeout=RPC_TIMEOUT)
+            await call(msg, **kwargs)
 
     async def sync_chain(self, peer: Identity,
                          from_round: int) -> AsyncIterator[Beacon]:
@@ -586,7 +609,8 @@ class GrpcClient(ProtocolClient):
     async def verify_beacon(self, peer: Identity, *, round: int,
                             prev_round: int, prev_sig: bytes,
                             signature: bytes,
-                            timeout: Optional[float] = None
+                            timeout: Optional[float] = None,
+                            trace_id: str = ""
                             ) -> "pb.VerifyBeaconResponse":
         """Remote verification of one chain link through the peer's
         serve/ gateway.  The peer sheds with RESOURCE_EXHAUSTED /
@@ -600,6 +624,7 @@ class GrpcClient(ProtocolClient):
             round=round, previous_round=prev_round,
             previous_signature=prev_sig, signature=signature,
             timeout_seconds=timeout or 0.0,
+            trace_id=trace_id,
         )
         return await call(
             req, timeout=(timeout or 0.0) + CONTROL_TIMEOUT

@@ -1,0 +1,61 @@
+"""Observability plane: span tracing, flight recorder, kernel timings.
+
+The triad any serving stack needs before it can be operated:
+
+* `obs.trace`  — dependency-free span tracer; one distributed trace per
+  beacon round (deterministic trace ids stitch all nodes) and per DKG
+  run, plus per-request gateway traces.
+* `obs.flight` — bounded ring buffer of the last N structured events
+  (finished spans, sheds, kernel dispatches, errors), dumped to disk on
+  crash/SIGTERM and served live at `GET /debug/flight`.
+* `obs.kernels` — `kernel_span(op, batch=...)` wraps every device
+  dispatch with block-until-ready wall timings feeding the tracer, the
+  `drand_device_kernel_seconds` histograms and the flight recorder.
+* `obs.introspect` — the `GET /v1/status` health document.
+
+Import cost is trivially small (stdlib only), so protocol modules import
+this unconditionally; sampling off (`DRAND_TPU_TRACE=off` or
+`TRACER.set_enabled(False)`) reduces every span to a shared no-op.
+"""
+
+from drand_tpu.obs.flight import RECORDER, FlightRecorder, install_crash_handler
+from drand_tpu.obs.kernels import block, kernel_span
+from drand_tpu.obs.trace import (
+    NOOP_SPAN,
+    TRACER,
+    Span,
+    Tracer,
+    derive_trace_id,
+    dkg_trace_id,
+    round_trace_id,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "NOOP_SPAN",
+    "RECORDER",
+    "Span",
+    "TRACER",
+    "Tracer",
+    "block",
+    "derive_trace_id",
+    "dkg_trace_id",
+    "install_crash_handler",
+    "kernel_span",
+    "round_trace_id",
+]
+
+
+def _span_to_flight(span_dict: dict) -> None:
+    RECORDER.record(
+        "span",
+        name=span_dict["name"],
+        trace_id=span_dict["trace_id"],
+        duration=span_dict["duration"],
+        status=span_dict["status"],
+    )
+
+
+# finished spans become flight-recorder events, so a crash dump carries
+# the recent span history even though the tracer itself is in-memory
+TRACER.add_sink(_span_to_flight)

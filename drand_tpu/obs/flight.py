@@ -1,0 +1,94 @@
+"""Bounded ring-buffer flight recorder.
+
+A drand node that crashes mid-round leaves no evidence: the metrics
+registry resets with the process and the trace store lives in memory.
+The flight recorder keeps the last N structured events — finished spans,
+gateway sheds, kernel dispatches, errors — in a lock-protected deque so
+a crash dump (`dump_to`) or the live `/debug/flight` endpoint can show
+the seconds leading up to an incident.
+
+Everything is plain dicts + `json.dumps(default=repr)`, so `dump()` is
+valid JSON even when concurrent writers are appending mid-serialise
+(the snapshot is taken under the lock).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+
+class FlightRecorder:
+    """Fixed-capacity event ring; thread-safe, allocation-light."""
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        self._seq = 0
+
+    def record(self, kind: str, **fields) -> None:
+        ev = {"seq": 0, "ts": time.time(), "kind": kind}
+        ev.update(fields)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._events.append(ev)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [dict(ev) for ev in self._events]
+
+    def dump(self) -> str:
+        """All buffered events as a JSON document (oldest first)."""
+        snap = self.snapshot()
+        return json.dumps(
+            {"capacity": self.capacity, "events": snap},
+            default=repr,
+        )
+
+    def dump_to(self, path: str) -> None:
+        """Atomic write (tmp + rename) so a crash mid-dump never leaves
+        a truncated file where the post-mortem evidence should be."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(self.dump())
+        os.replace(tmp, path)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+#: process-wide recorder (tracer sink + gateway + kernels feed it)
+RECORDER = FlightRecorder()
+
+
+def install_crash_handler(path: str,
+                          recorder: Optional[FlightRecorder] = None):
+    """Chain onto sys.excepthook: on an unhandled exception, record it
+    and write the flight buffer to `path` before the process dies.
+    Returns the installed hook (handy for tests to uninstall)."""
+    rec = recorder if recorder is not None else RECORDER
+    prev = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        try:
+            rec.record("crash", error=repr(exc),
+                       type=getattr(exc_type, "__name__", str(exc_type)))
+            rec.dump_to(path)
+        except Exception:
+            pass  # never mask the original crash
+        prev(exc_type, exc, tb)
+
+    sys.excepthook = hook
+    return hook

@@ -1,0 +1,86 @@
+"""Health introspection: one JSON document describing a live node.
+
+`daemon_status` is duck-typed against `core.Drand` (everything is
+guarded with getattr), so a partially-assembled daemon — or a test stub
+carrying just a beacon handler — still renders a useful document instead
+of raising.  Served at `GET /v1/status` and pretty-printed by
+`cli.py status`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from drand_tpu.obs import flight, trace
+
+
+def _chain_status(beacon, now: float) -> Optional[dict]:
+    if beacon is None:
+        return None
+    head = beacon.store.last()
+    group = beacon.group
+    return {
+        "head_round": head.round if head is not None else None,
+        "genesis_time": group.genesis_time,
+        "period": group.period,
+        "threshold": group.threshold,
+        "nodes": len(group),
+        "running": bool(getattr(beacon, "_running", False)),
+        "expected_round": (
+            # what round the clock says the network should be on
+            max(0, int((now - group.genesis_time) // group.period) + 1)
+            if now >= group.genesis_time else 0
+        ),
+    }
+
+
+def _peer_status(beacon, now: float) -> dict:
+    if beacon is None:
+        return {}
+    return {
+        addr: {"last_seen": ts, "seconds_ago": round(now - ts, 3)}
+        for addr, ts in sorted(beacon.peer_seen.items())
+    }
+
+
+def _dkg_status(dkg) -> dict:
+    if dkg is None:
+        return {"state": "idle"}
+    if getattr(dkg, "_done", False):
+        return {"state": "done"}
+    return {
+        "state": "in_progress",
+        "dealt": bool(getattr(dkg, "_sent_deals", False)),
+    }
+
+
+def daemon_status(d) -> dict:
+    """Snapshot of a daemon's health (all fields best-effort)."""
+    clock = getattr(d, "clock", None)
+    now = clock.now() if clock is not None else time.time()
+    beacon = getattr(d, "beacon", None)
+    gateway = getattr(d, "_verify_gateway", None)
+    pair = getattr(d, "pair", None)
+    scheme = getattr(d, "scheme", None)
+    return {
+        "address": (pair.public.address if pair is not None else None),
+        "state": ("running" if beacon is not None
+                  else "waiting for DKG"),
+        "backend": (type(scheme).__name__ if scheme is not None
+                    else None),
+        "time": now,
+        "chain": _chain_status(beacon, now),
+        "dkg": _dkg_status(getattr(d, "dkg", None)),
+        "peers": _peer_status(beacon, now),
+        "serve": (gateway.stats() if gateway is not None else None),
+        "trace": {
+            "enabled": trace.TRACER.enabled,
+            "traces": trace.TRACER.trace_count(),
+            "dropped_spans": trace.TRACER.dropped,
+        },
+        "flight": {
+            "events": len(flight.RECORDER),
+            "capacity": flight.RECORDER.capacity,
+        },
+    }

@@ -1,0 +1,82 @@
+"""Device-kernel timing hooks.
+
+The crypto backends dispatch four kernel families — pairing checks,
+MSM/Lagrange recovery, G2 signing and hash-to-curve — and asynchronous
+dispatch means naive `time.time()` around a jax call measures trace
+time, not device time.  `kernel_span` gives every call site one idiom:
+
+    with kernel_span("pairing_check", batch=len(msgs)):
+        ok = bool(np.asarray(jitted(...)))   # forces sync
+
+and feeds three consumers at once:
+
+* the per-op `drand_device_kernel_seconds` histogram (same metric name
+  and labels the backends used before, so dashboards keep working),
+* a `kernel.<op>` span under whatever round/batch span is current in
+  the calling context (kernel attribution inside a round trace),
+* a flight-recorder event, so the crash dump shows the last dispatches.
+
+`block()` is for call sites whose return value does NOT already force a
+device sync — it calls `jax.block_until_ready` when jax is importable
+and degrades to identity otherwise (pure-Python backends).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict
+
+from drand_tpu.obs import flight, trace
+from drand_tpu.utils import metrics
+
+_hists: Dict[str, object] = {}
+
+
+def _hist(op: str):
+    h = _hists.get(op)
+    if h is None:
+        h = _hists[op] = metrics.histogram(
+            "drand_device_kernel_seconds",
+            "Wall time of device kernel dispatches (block_until_ready)",
+            labels={"op": op},
+        )
+    return h
+
+
+def block(x):
+    """Force device completion when `x` is a jax value; no-op for
+    host-side values (Ref/Native backends)."""
+    try:
+        import jax
+
+        return jax.block_until_ready(x)
+    except Exception:
+        return x
+
+
+@contextlib.contextmanager
+def kernel_span(op: str, **attrs):
+    """Time one kernel dispatch: histogram + trace span + flight event.
+
+    The span parents to the caller's current span (context flows through
+    `asyncio.to_thread`), so kernel time shows up inside round traces.
+    """
+    span = trace.TRACER.span(f"kernel.{op}", attrs=attrs)
+    span.__enter__()
+    t0 = time.perf_counter()
+    try:
+        yield span
+    except BaseException as exc:
+        dt = time.perf_counter() - t0
+        _hist(op).observe(dt)
+        flight.RECORDER.record("kernel", op=op, seconds=dt,
+                               error=repr(exc), **attrs)
+        span.__exit__(type(exc), exc, exc.__traceback__)
+        raise
+    else:
+        dt = time.perf_counter() - t0
+        _hist(op).observe(dt)
+        span.set_attr("seconds", dt)
+        flight.RECORDER.record("kernel", op=op, seconds=dt, **attrs)
+        span.__exit__(None, None, None)

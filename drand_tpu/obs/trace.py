@@ -1,0 +1,288 @@
+"""Dependency-free span tracer for the beacon pipeline.
+
+The reference operates on logs alone; a multi-stage distributed pipeline
+(sign partial -> gossip -> collect -> recover -> verify -> store) needs
+spans to show *where* a round's time went, per node and per kernel
+dispatch.  This is the minimal OpenTelemetry-shaped core the daemon
+needs, with zero third-party dependencies so the pure-protocol path
+stays importable without jax or otel wheels:
+
+* `Span`: monotonic-clock interval with trace/span ids, attributes and a
+  parent link; a context manager that marks itself errored when the body
+  raises (including the round loop's ticker-is-king cancellation).
+* `Tracer`: bounded in-memory store of finished spans grouped by trace
+  id, with a contextvar "current span" so nested spans auto-link — the
+  context flows through `asyncio.to_thread` (it copies the context), so
+  kernel spans recorded from worker threads still attach to the round.
+* Deterministic round trace ids: every node derives the SAME id for a
+  round from the chain identity (genesis seed), so the partial-verify
+  spans of all nodes stitch into one distributed trace without any
+  coordination; the id additionally rides the `trace_id` proto field and
+  gRPC metadata so out-of-group observers can join too.
+* Sampling switch: with tracing disabled, `span()` hands back a shared
+  no-op singleton — no allocation, no clock reads, no storage — which a
+  test pins down (tracer overhead must be bounded).
+
+`DRAND_TPU_TRACE=off` disables the process-wide tracer at import.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import hashlib
+import os
+import secrets
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional
+
+_current_span: "contextvars.ContextVar[Optional[Span]]" = (
+    contextvars.ContextVar("drand_tpu_span", default=None)
+)
+
+
+def _new_id() -> str:
+    return secrets.token_hex(8)
+
+
+def derive_trace_id(kind: str, seed: bytes) -> str:
+    """Deterministic 16-hex-char trace id from a protocol identity."""
+    h = hashlib.sha256(b"drand-tpu-trace:" + kind.encode() + b":" + seed)
+    return h.hexdigest()[:16]
+
+
+def round_trace_id(genesis_seed: bytes, round: int) -> str:
+    """The trace id of one beacon round: every group member derives the
+    same value, so one round = one distributed trace across all nodes."""
+    return derive_trace_id(
+        "round", genesis_seed + round.to_bytes(8, "big")
+    )
+
+
+def dkg_trace_id(session_id: bytes) -> str:
+    """One trace per DKG run, derived from its session id (group hash)."""
+    return derive_trace_id("dkg", session_id)
+
+
+class _NoopSpan:
+    """Shared do-nothing span returned when sampling is off."""
+
+    __slots__ = ()
+
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    parent_id: Optional[str] = None
+    name = ""
+    attrs: dict = {}
+    status = "ok"
+    duration = 0.0
+
+    def set_attr(self, key, value) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """One timed interval.  Use as a context manager; attributes are
+    free-form JSON-safe values.  Durations come from the monotonic
+    clock; `start_unix` is wall time for display only."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "attrs",
+                 "status", "start", "start_unix", "end", "_tracer",
+                 "_token")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: Optional[str], attrs: dict):
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.name = name
+        self.attrs = attrs
+        self.status = "ok"
+        self.start = time.monotonic()
+        self.start_unix = time.time()
+        self.end: Optional[float] = None
+        self._tracer = tracer
+        self._token = None
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def finish(self) -> None:
+        if self.end is not None:
+            return
+        self.end = time.monotonic()
+        if self._token is not None:
+            try:
+                _current_span.reset(self._token)
+            except ValueError:
+                pass  # finished from a different context — harmless
+            self._token = None
+        self._tracer._record(self)
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "start_unix": self.start_unix,
+            "duration": self.duration,
+            "status": self.status,
+            "attrs": dict(self.attrs),
+        }
+
+    def __enter__(self) -> "Span":
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            self.status = "error"
+            self.attrs.setdefault("error", repr(exc))
+        self.finish()
+        return False
+
+
+class Tracer:
+    """Thread-safe bounded store of finished spans, grouped by trace.
+
+    Old traces are evicted FIFO past `max_traces`; one trace keeps at
+    most `max_spans_per_trace` spans (overflow counts in `dropped`).
+    Sinks (e.g. the flight recorder) see every finished span dict.
+    """
+
+    def __init__(self, max_traces: int = 256,
+                 max_spans_per_trace: int = 512,
+                 enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.environ.get(
+                "DRAND_TPU_TRACE", "on"
+            ).lower() not in ("off", "0", "false")
+        self.max_traces = max_traces
+        self.max_spans_per_trace = max_spans_per_trace
+        self._enabled = enabled
+        self._lock = threading.Lock()
+        self._traces: "OrderedDict[str, List[dict]]" = OrderedDict()
+        self._sinks: List[Callable[[dict], None]] = []
+        self.dropped = 0
+
+    # -- sampling ----------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, on: bool) -> None:
+        self._enabled = bool(on)
+
+    # -- span creation -----------------------------------------------------
+
+    def span(self, name: str, *, trace_id: Optional[str] = None,
+             parent: Optional[Span] = None, attrs: Optional[dict] = None):
+        """Open a span.  Parent defaults to the context's current span;
+        trace id defaults to the parent's (fresh otherwise)."""
+        if not self._enabled:
+            return NOOP_SPAN
+        if parent is None:
+            parent = _current_span.get()
+        if parent is NOOP_SPAN:
+            parent = None
+        parent_id = parent.span_id if parent is not None else None
+        if trace_id is None:
+            trace_id = (parent.trace_id if parent is not None
+                        else _new_id())
+        return Span(self, name, trace_id, parent_id,
+                    dict(attrs) if attrs else {})
+
+    def current(self) -> Optional[Span]:
+        cur = _current_span.get()
+        return None if cur is None or cur is NOOP_SPAN else cur
+
+    def current_trace_id(self) -> Optional[str]:
+        cur = self.current()
+        return None if cur is None else cur.trace_id
+
+    # -- storage -----------------------------------------------------------
+
+    def _record(self, span: Span) -> None:
+        d = span.to_dict()
+        with self._lock:
+            spans = self._traces.get(span.trace_id)
+            if spans is None:
+                spans = self._traces[span.trace_id] = []
+                while len(self._traces) > self.max_traces:
+                    self._traces.popitem(last=False)
+            if len(spans) < self.max_spans_per_trace:
+                spans.append(d)
+            else:
+                self.dropped += 1
+            self._traces.move_to_end(span.trace_id)
+        for sink in self._sinks:
+            try:
+                sink(d)
+            except Exception:
+                pass  # a broken sink must never break the traced code
+
+    def add_sink(self, fn: Callable[[dict], None]) -> None:
+        self._sinks.append(fn)
+
+    def get_trace(self, trace_id: str) -> Optional[dict]:
+        with self._lock:
+            spans = self._traces.get(trace_id)
+            if spans is None:
+                return None
+            return {"trace_id": trace_id, "spans": [dict(s) for s in spans]}
+
+    def recent(self, n: int = 20) -> List[dict]:
+        """The n most recently updated traces, newest first."""
+        with self._lock:
+            ids = list(self._traces.keys())[-n:][::-1]
+            return [
+                {"trace_id": tid,
+                 "spans": [dict(s) for s in self._traces[tid]]}
+                for tid in ids
+            ]
+
+    def find_round(self, round: int) -> List[dict]:
+        """Traces containing a span tagged with this beacon round."""
+        with self._lock:
+            out = []
+            for tid, spans in reversed(self._traces.items()):
+                if any(s["attrs"].get("round") == round for s in spans):
+                    out.append({"trace_id": tid,
+                                "spans": [dict(s) for s in spans]})
+            return out
+
+    def trace_count(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._traces.clear()
+            self.dropped = 0
+
+
+#: process-wide tracer (the daemon, gateway and kernels all feed it)
+TRACER = Tracer()
+
+span = TRACER.span
+current_trace_id = TRACER.current_trace_id

@@ -20,9 +20,18 @@ Importing this package enables JAX's persistent compilation cache (set
 ``DRAND_TPU_XLA_CACHE`` to relocate it, or to ``off`` to disable): the
 pairing pipeline costs minutes of XLA compile time per shape on a small
 host but milliseconds to reload from cache.
+
+Every entry point is dispatched through ``obs.kernels.kernel_span`` by
+the crypto backends (crypto/tbls.py): block-until-ready wall timings with
+batch/padded-shape attributes feed the ``drand_device_kernel_seconds``
+histograms, the round trace and the flight recorder.
 """
 
 import os as _os
+
+#: kernel families the observability plane times (obs/kernels.py);
+#: `kernel.<op>` spans and per-op histogram series use these names
+INSTRUMENTED_KERNELS = ("pairing_check", "msm_recover", "g2_sign", "h2c")
 
 import jax as _jax
 

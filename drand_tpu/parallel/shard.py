@@ -15,6 +15,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.6 exports shard_map at top level (kwarg: check_vma)
+    shard_map = jax.shard_map
+except AttributeError:  # 0.4.x ships it as experimental (kwarg: check_rep)
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
 from drand_tpu.ops import pairing
 from drand_tpu.ops.curve import (
     F1,
@@ -86,7 +95,7 @@ def _sharded_msm(points, bits, *, mesh: Mesh, F: FieldOps,
     # EVIDENCED by tests/test_shard.py::test_sharded_msm_replication,
     # which runs this same body with per_device=True (out_specs sharded,
     # one combined sum per device) and asserts all devices agree.
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis), P(axis)),

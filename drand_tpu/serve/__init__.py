@@ -23,6 +23,7 @@ from drand_tpu.serve.gateway import (
     GatewayClosed,
     GatewayError,
     Overloaded,
+    Oversize,
     VerifyGateway,
     VerifyRequest,
     VerifyResult,
@@ -35,6 +36,7 @@ __all__ = [
     "GatewayClosed",
     "GatewayError",
     "Overloaded",
+    "Oversize",
     "VerifiedRoundCache",
     "VerifyGateway",
     "VerifyRequest",

@@ -40,6 +40,10 @@ class BatchItem:
     future: "asyncio.Future" = field(
         default_factory=lambda: asyncio.get_event_loop().create_future()
     )
+    #: the submitter's request span (obs.trace.Span or None) — the flush
+    #: callback stamps batch links onto it so a request's trace shows
+    #: which kernel batch served it
+    span: object = None
 
 
 class BatchScheduler:

@@ -27,13 +27,16 @@ Failure semantics are explicit, never silent latency:
 from __future__ import annotations
 
 import asyncio
+import contextvars
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set
 
 from drand_tpu.beacon.chain import Beacon, beacon_message
 from drand_tpu.crypto import refimpl as ref
 from drand_tpu.crypto import tbls
+from drand_tpu.obs import flight as obs_flight
+from drand_tpu.obs import trace as obs_trace
 from drand_tpu.serve.batcher import BatchItem, BatchScheduler
 from drand_tpu.serve.cache import VerifiedRoundCache
 from drand_tpu.utils import metrics
@@ -69,7 +72,7 @@ _shed = {
         "requests rejected instead of served late",
         labels={"reason": reason},
     )
-    for reason in ("queue_full", "deadline")
+    for reason in ("queue_full", "deadline", "oversize")
 }
 _requests = {
     result: metrics.counter(
@@ -78,6 +81,27 @@ _requests = {
     )
     for result in ("valid", "invalid")
 }
+
+#: cap the per-client label cardinality: past this many distinct clients
+#: new ones aggregate under "_other" (a flooding scraper must not be able
+#: to blow up the registry)
+_MAX_CLIENT_SERIES = 256
+_client_series: Set[str] = set()
+
+
+def _count_client_request(client: Optional[str]) -> None:
+    """Per-client request counts — the raw data the ROADMAP's per-client
+    fairness follow-up needs before any shedding policy can use it."""
+    name = client or "unknown"
+    if name not in _client_series:
+        if len(_client_series) >= _MAX_CLIENT_SERIES:
+            name = "_other"
+        _client_series.add(name)
+    metrics.counter(
+        "drand_serve_client_requests_total",
+        "verification requests by client identity",
+        labels={"client": name},
+    ).inc()
 
 
 def _consume_exception(fut: "asyncio.Future") -> None:
@@ -95,6 +119,20 @@ class Overloaded(GatewayError):
 
 class DeadlineExceeded(GatewayError):
     """The request's deadline passed before its batch was assembled."""
+
+
+class Oversize(GatewayError):
+    """A signature exceeds the BLS encoding bound — rejected at
+    admission so a garbage blob never occupies a kernel slot (REST 413 /
+    gRPC INVALID_ARGUMENT)."""
+
+    def __init__(self, limit: int, actual: int):
+        super().__init__(
+            f"signature of {actual} bytes exceeds the "
+            f"{limit}-byte BLS bound"
+        )
+        self.limit = limit
+        self.actual = actual
 
 
 class GatewayClosed(GatewayError):
@@ -161,6 +199,9 @@ class VerifyGateway:
         self._executor: Optional[ThreadPoolExecutor] = None
         self._started = False
         self._closed = False
+        # per-instance cache accounting for /v1/status hit rate
+        self._hits = 0
+        self._misses = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -191,6 +232,7 @@ class VerifyGateway:
         if self._executor is not None:
             self._executor.shutdown(wait=False)
             self._executor = None
+        _queue_depth.set(0)
 
     async def __aenter__(self) -> "VerifyGateway":
         await self.start()
@@ -202,15 +244,42 @@ class VerifyGateway:
     # -- request path ------------------------------------------------------
 
     async def verify(self, req: VerifyRequest,
-                     timeout: Optional[float] = None) -> VerifyResult:
-        """Verify one claim; returns a verdict or raises a GatewayError."""
+                     timeout: Optional[float] = None, *,
+                     client: Optional[str] = None,
+                     trace_id: Optional[str] = None) -> VerifyResult:
+        """Verify one claim; returns a verdict or raises a GatewayError.
+
+        `client` is an opaque caller identity (peer address / header) for
+        the per-client request counters; `trace_id` joins the caller's
+        distributed trace when propagated."""
         if self._closed or not self._started:
             raise GatewayClosed("gateway is not serving")
+        _count_client_request(client)
+        attrs = {"round": req.round}
+        if client:
+            attrs["client"] = client
+        with obs_trace.TRACER.span(
+            "gateway.verify", trace_id=trace_id or None, attrs=attrs,
+        ) as span:
+            return await self._verify_inner(req, timeout, span)
+
+    async def _verify_inner(self, req: VerifyRequest,
+                            timeout: Optional[float],
+                            span) -> VerifyResult:
+        n = max(len(req.signature), len(req.prev_sig))
+        if n > tbls.SIG_LEN:
+            _shed["oversize"].inc()
+            obs_flight.RECORDER.record("shed", reason="oversize",
+                                       round=req.round, bytes=n)
+            raise Oversize(limit=tbls.SIG_LEN, actual=n)
         key = req.key()
         if self.cache.hit(key):
+            self._hits += 1
             _cache_hits.inc()
             _requests["valid"].inc()
+            span.set_attr("cached", True)
             return VerifyResult(valid=True, cached=True)
+        self._misses += 1
 
         loop = asyncio.get_event_loop()
         timeout = self.default_timeout if timeout is None else timeout
@@ -222,12 +291,14 @@ class VerifyGateway:
             if item.deadline is not None:
                 item.deadline = max(item.deadline, deadline)
             _coalesced.inc()
+            span.set_attr("coalesced", True)
         else:
             if timeout <= 0:
                 _shed["deadline"].inc()
                 raise DeadlineExceeded("deadline expired before admission")
             item = BatchItem(payload=req, deadline=deadline,
-                             future=loop.create_future())
+                             future=loop.create_future(),
+                             span=obs_trace.TRACER.current())
             # every waiter may abandon the slot (wait_for timeout); mark
             # a late exception as retrieved so GC never logs noise
             item.future.add_done_callback(_consume_exception)
@@ -235,12 +306,14 @@ class VerifyGateway:
                 self._batcher.submit(item)
             except asyncio.QueueFull:
                 _shed["queue_full"].inc()
+                obs_flight.RECORDER.record("shed", reason="queue_full",
+                                           round=req.round)
                 raise Overloaded(
                     f"verification queue full "
                     f"({self._batcher._queue.maxsize} deep); retry later"
                 ) from None
             self._inflight[key] = item
-            _queue_depth.set(self._batcher.depth)
+            _queue_depth.inc()
         # outer wait_for is a backstop for coalesced waiters whose own
         # deadline is earlier than the slot's extended one
         try:
@@ -249,19 +322,37 @@ class VerifyGateway:
             )
         except asyncio.TimeoutError:
             _shed["deadline"].inc()
+            obs_flight.RECORDER.record("shed", reason="deadline",
+                                       round=req.round)
             raise DeadlineExceeded(
                 f"no verdict within {timeout:.3f}s"
             ) from None
 
     async def verify_many(self, reqs: Sequence[VerifyRequest],
-                          timeout: Optional[float] = None
+                          timeout: Optional[float] = None, *,
+                          client: Optional[str] = None
                           ) -> List[VerifyResult]:
         """Concurrent verify of several claims (they share batches);
         per-item GatewayErrors come back in-place as exceptions."""
         return await asyncio.gather(
-            *(self.verify(r, timeout) for r in reqs),
+            *(self.verify(r, timeout, client=client) for r in reqs),
             return_exceptions=True,
         )
+
+    def stats(self) -> dict:
+        """Live gateway state for /v1/status."""
+        total = self._hits + self._misses
+        return {
+            "backend": type(self.scheme).__name__,
+            "queue_depth": self._batcher.depth,
+            "max_queue": self._batcher._queue.maxsize,
+            "max_batch": self._batcher.max_batch,
+            "max_wait": self._batcher.max_wait,
+            "inflight": len(self._inflight),
+            "cache_entries": len(self.cache),
+            "cache_hit_rate": (self._hits / total) if total else None,
+            "closed": self._closed,
+        }
 
     # -- batch flush (BatchScheduler callback) -----------------------------
 
@@ -271,7 +362,8 @@ class VerifyGateway:
 
     async def _flush(self, items: List[BatchItem]) -> None:
         loop = asyncio.get_event_loop()
-        _queue_depth.set(self._batcher.depth)
+        # popped off the queue: locked dec mirrors the per-submit inc
+        _queue_depth.dec(float(len(items)))
         now = loop.time()
         live: List[BatchItem] = []
         for item in items:
@@ -279,6 +371,8 @@ class VerifyGateway:
             self._inflight.pop(req.key(), None)
             if item.deadline is not None and now > item.deadline:
                 _shed["deadline"].inc()
+                obs_flight.RECORDER.record("shed", reason="deadline",
+                                           round=req.round)
                 if not item.future.done():
                     item.future.set_exception(DeadlineExceeded(
                         "deadline passed while queued"
@@ -290,10 +384,24 @@ class VerifyGateway:
         msgs = [item.payload.message() for item in live]
         sigs = [item.payload.signature for item in live]
         _batch_size.observe(float(len(live)))
-        with _batch_seconds.time():
-            verdicts = await loop.run_in_executor(
-                self._executor, self._run_kernel, msgs, sigs
-            )
+        with obs_trace.TRACER.span(
+            "gateway.batch", attrs={"requests": len(live)},
+        ) as bspan:
+            # link every request span to the batch that served it (and
+            # vice versa the batch id is enough to find all riders)
+            if bspan.span_id is not None:
+                for item in live:
+                    if item.span is not None:
+                        item.span.set_attr("batch_span", bspan.span_id)
+                        item.span.set_attr("batch_trace", bspan.trace_id)
+            with _batch_seconds.time():
+                # run_in_executor does NOT copy the contextvars context
+                # (unlike asyncio.to_thread) — carry it explicitly so the
+                # backend's kernel spans parent to this batch span
+                ctx = contextvars.copy_context()
+                verdicts = await loop.run_in_executor(
+                    self._executor, ctx.run, self._run_kernel, msgs, sigs
+                )
         for item, ok in zip(live, verdicts):
             ok = bool(ok)
             _requests["valid" if ok else "invalid"].inc()

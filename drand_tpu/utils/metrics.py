@@ -24,10 +24,18 @@ _BUCKETS = (
 )
 
 
+def _escape_label_value(v: str) -> str:
+    # Prometheus exposition: backslash, double-quote and newline must be
+    # escaped inside label values or the line breaks the parser.
+    return (str(v).replace("\\", "\\\\")
+                  .replace('"', '\\"')
+                  .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in labels)
     return "{" + inner + "}"
 
 
@@ -48,9 +56,19 @@ class Counter:
 class Gauge:
     def __init__(self):
         self._v = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self._v = v
+        with self._lock:
+            self._v = v
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v -= amount
 
     @property
     def value(self) -> float:

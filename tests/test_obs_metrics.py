@@ -1,0 +1,123 @@
+"""Exposition-format hardening for utils/metrics.py.
+
+A scrape that silently drops series is worse than no metrics: a label
+value carrying a backslash, quote or newline used to break the line for
+any conformant Prometheus parser.  These tests parse the rendered text
+with a minimal in-test parser (the inverse of `_escape_label_value`) and
+pin down bucket arithmetic and the new locked Gauge.inc/dec."""
+
+import threading
+
+from drand_tpu.utils.metrics import Gauge, Registry
+
+_UNESCAPE = {"\\": "\\", '"': '"', "n": "\n"}
+
+
+def _parse_labels(s: str, i: int):
+    """Parse `{k="v",...}` starting at s[i] == '{'; returns (labels, end)."""
+    labels = {}
+    i += 1
+    while s[i] != "}":
+        eq = s.index("=", i)
+        key = s[i:eq]
+        assert s[eq + 1] == '"', f"label {key}: value must be quoted"
+        j = eq + 2
+        out = []
+        while s[j] != '"':
+            if s[j] == "\\":
+                out.append(_UNESCAPE[s[j + 1]])
+                j += 2
+            else:
+                out.append(s[j])
+                j += 1
+        labels[key] = "".join(out)
+        i = j + 1
+        if s[i] == ",":
+            i += 1
+    return labels, i + 1
+
+
+def parse_exposition(text: str):
+    """Minimal Prometheus text-format parser: every sample line becomes
+    {(name, frozenset(labels.items())): float}.  Raises on any line a
+    real scraper would reject."""
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        brace = line.find("{")
+        space = line.find(" ")
+        if brace != -1 and brace < space:
+            name = line[:brace]
+            labels, end = _parse_labels(line, brace)
+            assert line[end] == " ", f"junk after labels: {line!r}"
+            value = float(line[end + 1:])
+        else:
+            name, _, raw = line.partition(" ")
+            labels, value = {}, float(raw)
+        key = (name, frozenset(labels.items()))
+        assert key not in samples, f"duplicate series: {line!r}"
+        samples[key] = value
+    return samples
+
+
+def test_escaped_label_values_round_trip():
+    reg = Registry()
+    ugly = 'a\\b"c\nd'
+    reg.counter("weird_total", "w", labels={"path": ugly}).inc(3)
+    text = reg.render()
+    # the newline must be escaped, not emitted raw (one sample line)
+    assert sum("weird_total" in ln for ln in text.splitlines()
+               if not ln.startswith("#")) == 1
+    samples = parse_exposition(text)
+    assert samples[("weird_total", frozenset({("path", ugly)}))] == 3.0
+
+
+def test_histogram_buckets_cumulative_and_inf_equals_count():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "latency", labels={"op": "x"})
+    for v in (0.0001, 0.002, 0.002, 0.7, 1e9):  # incl. overflow bucket
+        h.observe(v)
+    samples = parse_exposition(reg.render())
+
+    buckets = {
+        dict(labels)["le"]: v
+        for (name, labels), v in samples.items()
+        if name == "lat_seconds_bucket"
+    }
+    finite = sorted((le for le in buckets if le != "+Inf"), key=float)
+    counts = [buckets[le] for le in finite]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    count = samples[("lat_seconds_count", frozenset({("op", "x")}))]
+    assert buckets["+Inf"] == count == 5
+    assert counts[-1] <= buckets["+Inf"]
+
+
+def test_gauge_inc_dec_locked_balance():
+    g = Gauge()
+    n, per = 8, 2000
+
+    def work():
+        for _ in range(per):
+            g.inc()
+            g.dec(0.5)
+            g.dec(0.5)
+
+    threads = [threading.Thread(target=work) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert g.value == 0.0
+
+    g.inc(2.5)
+    g.dec()
+    assert g.value == 1.5
+
+
+def test_gauge_in_registry_renders():
+    reg = Registry()
+    g = reg.gauge("depth", "queue depth")
+    g.inc(4)
+    g.dec()
+    assert parse_exposition(reg.render())[("depth", frozenset())] == 3.0

@@ -1,0 +1,192 @@
+"""obs/ unit tier: span tracer semantics, the sampling kill-switch, the
+flight recorder's ring bound and crash hook, and the distributed-trace
+stitching of a 2-node beacon round (every node derives the same round
+trace id, so their spans land in one trace with no coordination)."""
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from drand_tpu.obs import flight, trace
+from drand_tpu.obs.trace import NOOP_SPAN, Tracer, round_trace_id
+from drand_tpu.utils.clock import FakeClock
+
+from test_beacon import build_network, wait_for_round
+
+
+# -- tracer ----------------------------------------------------------------
+
+
+def test_span_nesting_parents_and_attrs():
+    tr = Tracer(enabled=True)
+    with tr.span("outer", attrs={"round": 7}) as outer:
+        with tr.span("inner") as inner:
+            inner.set_attr("k", "v")
+            assert tr.current() is inner
+        assert tr.current() is outer
+    assert tr.current() is None
+
+    t = tr.get_trace(outer.trace_id)
+    by_name = {s["name"]: s for s in t["spans"]}
+    assert by_name["inner"]["parent_id"] == outer.span_id
+    assert by_name["inner"]["trace_id"] == outer.trace_id
+    assert by_name["outer"]["attrs"] == {"round": 7}
+    assert by_name["inner"]["attrs"] == {"k": "v"}
+    # inner closed first and sits inside outer's interval
+    assert 0 <= by_name["inner"]["duration"] <= by_name["outer"]["duration"]
+    assert tr.find_round(7)[0]["trace_id"] == outer.trace_id
+
+
+def test_span_marks_error_on_exception():
+    tr = Tracer(enabled=True)
+    with pytest.raises(ValueError):
+        with tr.span("boom") as s:
+            raise ValueError("nope")
+    d = tr.get_trace(s.trace_id)["spans"][0]
+    assert d["status"] == "error"
+    assert "nope" in d["attrs"]["error"]
+
+
+def test_disabled_tracer_hands_back_the_noop_singleton():
+    """The sampling switch must make tracing free: same shared object
+    every time, no storage, no contextvar writes."""
+    tr = Tracer(enabled=False)
+    s = tr.span("x", attrs={"round": 1})
+    assert s is NOOP_SPAN
+    assert tr.span("y") is s  # no allocation per call
+    with s:
+        s.set_attr("a", 1)
+        assert tr.current() is None
+    assert tr.trace_count() == 0
+    assert s.attrs == {}
+
+    tr.set_enabled(True)
+    live = tr.span("z")
+    assert live is not NOOP_SPAN
+    live.finish()
+    assert tr.trace_count() == 1
+
+
+def test_tracer_bounds_traces_and_spans():
+    tr = Tracer(max_traces=4, max_spans_per_trace=2, enabled=True)
+    for i in range(10):
+        tr.span(f"s{i}", trace_id=f"t{i}").finish()
+    assert tr.trace_count() == 4  # FIFO eviction
+    for _ in range(5):
+        tr.span("again", trace_id="full").finish()
+    assert len(tr.get_trace("full")["spans"]) == 2
+    assert tr.dropped == 3
+
+
+def test_round_trace_id_is_deterministic():
+    a = round_trace_id(b"seed", 5)
+    assert a == round_trace_id(b"seed", 5)
+    assert a != round_trace_id(b"seed", 6)
+    assert a != round_trace_id(b"other-chain", 5)
+    assert len(a) == 16
+    int(a, 16)  # hex
+
+
+# -- flight recorder -------------------------------------------------------
+
+
+def test_flight_recorder_caps_at_capacity():
+    rec = flight.FlightRecorder(capacity=64)
+    for i in range(200):
+        rec.record("e", i=i)
+    assert len(rec) == 64
+    snap = rec.snapshot()
+    assert [e["seq"] for e in snap] == list(range(137, 201))
+    assert snap[-1]["i"] == 199
+    rec.clear()
+    assert len(rec) == 0
+
+
+def test_flight_dump_is_valid_json_under_concurrent_writers():
+    rec = flight.FlightRecorder(capacity=32)
+    stop = threading.Event()
+
+    def writer(n):
+        i = 0
+        while not stop.is_set():
+            # non-JSON value exercises the default=repr escape hatch
+            rec.record("w", worker=n, i=i, blob=object())
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(n,))
+               for n in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(50):
+            doc = json.loads(rec.dump())
+            assert doc["capacity"] == 32
+            assert len(doc["events"]) <= 32
+            for ev in doc["events"]:
+                assert ev["kind"] == "w"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+def test_crash_handler_dumps_and_chains(tmp_path, monkeypatch):
+    rec = flight.FlightRecorder(capacity=8)
+    rec.record("before")
+    chained = []
+    monkeypatch.setattr("sys.excepthook",
+                        lambda *a: chained.append(a))
+    path = tmp_path / "flight_dump.json"
+    hook = flight.install_crash_handler(str(path), rec)
+    hook(ValueError, ValueError("boom"), None)
+    doc = json.loads(path.read_text())
+    assert [e["kind"] for e in doc["events"]] == ["before", "crash"]
+    assert doc["events"][-1]["type"] == "ValueError"
+    assert chained, "previous excepthook must still run"
+
+
+# -- distributed stitching -------------------------------------------------
+
+
+async def test_two_node_round_stitches_into_one_trace():
+    """Both members of a 2-of-2 group emit their round pipeline under
+    the SAME deterministic trace id — one distributed trace per round."""
+    trace.TRACER.reset()
+    prev = trace.TRACER.enabled
+    trace.TRACER.set_enabled(True)
+    clock = FakeClock()
+    group, handlers, net, _ = build_network(2, 2, clock)
+    try:
+        for h in handlers:
+            await h.start()
+        await clock.advance(10)  # reach genesis -> round 1
+        await wait_for_round(handlers, 1)
+
+        tid = round_trace_id(group.get_genesis_seed(), 1)
+        addrs = {h.cfg.public.address for h in handlers}
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 60.0
+        while loop.time() < deadline:
+            t = trace.TRACER.get_trace(tid)
+            if t is not None:
+                roots = {s["attrs"].get("node") for s in t["spans"]
+                         if s["name"] == "beacon.round"}
+                if roots == addrs:
+                    break
+            await asyncio.sleep(0.02)
+        else:
+            raise TimeoutError(f"round trace {tid} never completed")
+
+        names = [s["name"] for s in t["spans"]]
+        # both nodes' pipelines and the cross-node partial verifies
+        assert names.count("beacon.round") == 2
+        assert names.count("beacon.sign") == 2
+        assert "beacon.partial_verify" in names
+        assert all(s["trace_id"] == tid for s in t["spans"])
+    finally:
+        for h in handlers:
+            await h.stop()
+        trace.TRACER.set_enabled(prev)
+        trace.TRACER.reset()

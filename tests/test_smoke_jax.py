@@ -12,6 +12,7 @@ import numpy as np
 
 from drand_tpu.crypto import refimpl as ref
 from drand_tpu.ops import fp
+from drand_tpu.parallel.shard import shard_map
 
 
 def test_virtual_mesh_present():
@@ -37,7 +38,7 @@ def test_fp_add_jit_smoke():
 def test_psum_on_mesh_smoke():
     mesh = jax.sharding.Mesh(np.array(jax.devices()), ("d",))
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda x: jax.lax.psum(x, "d"),
             mesh=mesh,
             in_specs=jax.sharding.PartitionSpec("d"),

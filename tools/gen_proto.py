@@ -71,7 +71,8 @@ def build_file() -> dp.FileDescriptorProto:
                  field("round", 2, U64),
                  field("previous_round", 3, U64),
                  field("previous_signature", 4, BYT),
-                 field("partial_signature", 5, BYT)))
+                 field("partial_signature", 5, BYT),
+                 field("trace_id", 6, STR)))
     m.append(msg("Empty"))
     m.append(msg("SyncRequest", field("from_round", 1, U64)))
     m.append(msg("BeaconRecord",
@@ -113,7 +114,8 @@ def build_file() -> dp.FileDescriptorProto:
                  field("previous_round", 2, U64),
                  field("previous_signature", 3, BYT),
                  field("signature", 4, BYT),
-                 field("timeout_seconds", 5, DBL)))
+                 field("timeout_seconds", 5, DBL),
+                 field("trace_id", 6, STR)))
     m.append(msg("VerifyBeaconResponse",
                  field("valid", 1, BOO),
                  field("cached", 2, BOO),
