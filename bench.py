@@ -30,6 +30,10 @@ Environment knobs:
   BENCH_DEVICE_ONLY  "1": skip hashing, time the pairing check alone
   BENCH_PROBE_TIMEOUT  seconds to wait for the ambient JAX backend
                        before falling back to CPU (default 240)
+  BENCH_FINALIZE  "1" forces the round_finalize sub-bench (fused
+                  partials->sig path) even on CPU; "0" disables it
+                  (default: runs on accelerators only)
+  BENCH_FINALIZE_ITERS  timed finalizes in the sub-bench (default 20)
   BENCH_PROFILE_DIR  write a JAX profiler trace of the timed iterations
                      here (inspect with xprof/tensorboard) — the
                      per-kernel breakdown VERDICT r3 asked for
@@ -143,6 +147,57 @@ def select_check_kernel():
     return kernel, jax.jit(pairing.pairing_product_check)
 
 
+def _bench_round_finalize() -> dict:
+    """Time the fused round-finalize path (partials -> verified
+    collective sig) end to end on JaxScheme, and count device dispatches
+    per finalize via the kernel spans.  Skipped by default on a CPU
+    fallback (compile cost >> signal there); force with
+    BENCH_FINALIZE=1, disable anywhere with BENCH_FINALIZE=0."""
+    import jax
+
+    mode = os.environ.get("BENCH_FINALIZE", "")
+    if mode == "0":
+        return {"skipped": "BENCH_FINALIZE=0"}
+    if mode != "1" and jax.default_backend().lower() == "cpu":
+        return {"skipped": "cpu backend (set BENCH_FINALIZE=1 to force)"}
+
+    from drand_tpu.crypto import tbls
+    from drand_tpu.crypto.poly import PriPoly
+    from drand_tpu.obs import trace as obs_trace
+
+    t, n = 3, 5
+    iters = int(os.environ.get("BENCH_FINALIZE_ITERS", "20"))
+    poly = PriPoly.random(t)
+    pub = poly.commit()
+    scheme = tbls.JaxScheme()
+    msg = b"drand-tpu bench finalize round"
+    partials = [
+        scheme.partial_sign(s, msg) for s in poly.shares(n)
+    ]
+    # warm: compiles the check + fused MSM programs, fills the plan cache
+    scheme.finalize_round(pub, msg, partials, t, n)
+
+    with obs_trace.TRACER.span("bench.finalize") as sp:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            sig = scheme.finalize_round(pub, msg, partials, t, n)
+        dt = time.perf_counter() - t0
+    assert len(sig) == tbls.SIG_LEN
+    dispatches = None
+    if sp.trace_id is not None:
+        tr = obs_trace.TRACER.get_trace(sp.trace_id)
+        if tr:
+            kernels = [s for s in tr["spans"]
+                       if s["name"].startswith("kernel.")]
+            dispatches = round(len(kernels) / iters, 2)
+    return {
+        "t": t, "n": n, "iters": iters,
+        "finalizes_per_sec": round(iters / dt, 1),
+        "seconds_per_finalize": round(dt / iters, 5),
+        "device_dispatches_per_finalize": dispatches,
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -225,6 +280,13 @@ def main() -> None:
             out.block_until_ready()
             times.append(time.perf_counter() - t0)
 
+    try:
+        finalize_detail = _bench_round_finalize()
+    except Exception as e:  # noqa: BLE001 — the headline row still ships
+        finalize_detail = {
+            "error": "%s: %s" % (type(e).__name__, str(e)[:200])
+        }
+
     per_rep = sorted(batch * iters / dt for dt in times)
     rounds_per_sec = float(np.median(per_rep))
     pairings_per_sec = 2 * rounds_per_sec
@@ -262,6 +324,7 @@ def main() -> None:
             "device": str(jax.devices()[0]),
             "cpu_fallback": os.environ.get("BENCH_FALLBACK") == "1",
             "est_1M_rounds_seconds": round(1_000_000 / rounds_per_sec, 1),
+            "round_finalize": finalize_detail,
         },
     }))
 

@@ -34,7 +34,6 @@ from drand_tpu.beacon.chain import (
     genesis_beacon,
     next_round,
     time_of_round,
-    verify_beacon,
 )
 from drand_tpu.beacon.round_cache import RoundManager
 from drand_tpu.beacon.store import BeaconStore, CallbackStore
@@ -134,6 +133,9 @@ class BeaconConfig:
     scheme: tbls.Scheme
     clock: Clock = field(default_factory=Clock)
     wait_time: float = 0.3  # reference core/constants.go:45
+    #: beacons verified per device batch during catch-up; the pipelined
+    #: sync prefetches the next batch while this one is on device
+    sync_batch: int = SYNC_BATCH
 
 
 class BeaconHandler:
@@ -323,18 +325,23 @@ class BeaconHandler:
                 partials[self.scheme.index_of(blob)] = blob
             agg_span.set_attr("partials", len(partials))
 
+        # fused finalize: verify the partials, Lagrange-recover the
+        # group signature and re-check it against the distributed key in
+        # ONE scheme call (JaxScheme: <= 2 device dispatches; other
+        # backends compose recover + verify_recovered).  Off-loop like
+        # sign — the pairing math must not starve inbound partials.
+        with obs_trace.TRACER.span(
+            "beacon.verify",
+            attrs={"round": round, "partials": len(partials),
+                   "fused": True},
+        ):
             sig = await asyncio.to_thread(
-                self.scheme.recover,
+                self.scheme.finalize_round,
                 self.pub_poly, msg, list(partials.values()),
                 self.group.threshold, len(self.group),
             )
         beacon = Beacon(round=round, prev_round=prev_round,
                         prev_sig=prev_sig, signature=sig)
-        with obs_trace.TRACER.span("beacon.verify",
-                                   attrs={"round": round}):
-            await asyncio.to_thread(
-                verify_beacon, self.scheme, self.dist_key, beacon
-            )
         # the head may have advanced while we were collecting — a benign
         # sync race, not a failure (the chain moved on without us)
         cur_head = self.store.last()
@@ -433,8 +440,12 @@ class BeaconHandler:
         """Pull missing beacons from peers, batch-verifying each segment.
 
         The reference verifies one pairing per synced round in a serial
-        loop (beacon.go:557-601); here segments of SYNC_BATCH rounds are
-        verified in a single batched device call.
+        loop (beacon.go:557-601); here segments of `cfg.sync_batch`
+        rounds are verified in a single batched device call, with the
+        next segment prefetched while the current one verifies
+        (see `_sync_from`).  Large segments route through the multi-chip
+        sharded pairing kernel when the scheme has a >1-device mesh
+        (tbls.JaxScheme._maybe_sharded).
         """
         peers = [n for n in (peers or self.group.nodes)
                  if n.address != self.cfg.public.address]
@@ -452,16 +463,48 @@ class BeaconHandler:
                 return  # caught up enough to join
 
     async def _sync_from(self, peer: Identity) -> None:
+        """Double-buffered catch-up from one peer: while batch k sits on
+        the device (`_verify_and_store` runs the pairing check in a
+        worker thread), batch k+1 is already streaming from the peer in
+        a prefetch task — network pull and device verify overlap instead
+        of strictly alternating, so a slow peer no longer idles the chip
+        (and a busy chip no longer idles the socket)."""
         head = self.store.last()
         assert head is not None
-        batch: List[Beacon] = []
-        async for b in self.client.sync_chain(peer, head.round + 1):
-            batch.append(b)
-            if len(batch) >= SYNC_BATCH:
-                head = await self._verify_and_store(head, batch)
-                batch = []
-        if batch:
-            await self._verify_and_store(head, batch)
+        stream = self.client.sync_chain(peer, head.round + 1)
+        limit = max(1, self.cfg.sync_batch)
+
+        async def next_batch() -> List[Beacon]:
+            batch: List[Beacon] = []
+            async for b in stream:
+                batch.append(b)
+                if len(batch) >= limit:
+                    break
+            return batch
+
+        try:
+            batch = await next_batch()
+            while batch:
+                prefetch = asyncio.create_task(next_batch())
+                try:
+                    head = await self._verify_and_store(head, batch)
+                except BaseException:
+                    # a broken link / bad signature must not orphan the
+                    # in-flight prefetch (or leak its exception)
+                    prefetch.cancel()
+                    try:
+                        await prefetch
+                    except BaseException:
+                        pass
+                    raise
+                batch = await prefetch
+        finally:
+            aclose = getattr(stream, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:
+                    pass
 
     async def _verify_and_store(self, head: Beacon,
                                 batch: List[Beacon]) -> Beacon:

@@ -131,12 +131,23 @@ def _load_certs_dir(cert_manager, certs_dir) -> int:
     return n
 
 
+def _apply_compile_cache(args) -> None:
+    """Publish --compile-cache as DRAND_TPU_COMPILE_CACHE before any
+    scheme is built: JaxScheme.__init__ (and ops import) re-reads the
+    env via ops.configure_compile_cache, so the flag takes effect even
+    though jax may already be imported."""
+    if getattr(args, "compile_cache", None):
+        os.environ["DRAND_TPU_COMPILE_CACHE"] = args.compile_cache
+
+
 def cmd_start(args) -> int:
     import signal
 
     from drand_tpu.core import Config, Drand
     from drand_tpu.crypto import tbls
     from drand_tpu.obs import flight, install_crash_handler
+
+    _apply_compile_cache(args)
 
     # post-mortem evidence next to the keys: an unhandled exception (and
     # SIGTERM below) dumps the flight-recorder ring buffer before exit
@@ -210,6 +221,8 @@ def cmd_warmup(args) -> int:
     """
     import subprocess
     import time as _time
+
+    _apply_compile_cache(args)
 
     # A broken ambient accelerator backend can raise OR hang inside JAX
     # init; probe it in a subprocess (same self-healing contract as
@@ -293,6 +306,7 @@ def cmd_verify_serve(args) -> int:
     from drand_tpu.net.rest import build_verify_app, start_rest
     from drand_tpu.serve import VerifyGateway
 
+    _apply_compile_cache(args)
     try:
         # schemes take the collective key as a decoded G1 point (the
         # same shape DistPublic.key() hands the daemon), not wire bytes
@@ -312,6 +326,7 @@ def cmd_verify_serve(args) -> int:
             max_wait=args.max_wait,
             max_queue=args.max_queue,
             cache_size=args.cache_size,
+            client_max_inflight=args.client_max_inflight,
         )
         await gateway.start()
         runner, port = await start_rest(
@@ -613,6 +628,12 @@ def build_parser() -> argparse.ArgumentParser:
              "DRAND_TPU_BACKEND overrides); native = C++ host backend; "
              "ref = pure-Python oracle",
     )
+    g.add_argument(
+        "--compile-cache", metavar="DIR",
+        help="persistent XLA compile cache directory (default "
+             "~/.cache/drand_tpu_xla; DRAND_TPU_COMPILE_CACHE overrides; "
+             "'off' disables)",
+    )
     g.set_defaults(fn=cmd_start)
 
     g = sub.add_parser("warmup")
@@ -620,6 +641,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--threshold", dest="thresholds", type=int, action="append",
         help="warm the MSM/flood kernels for this committee threshold "
              "(repeatable; default 2 and 3)",
+    )
+    g.add_argument(
+        "--compile-cache", metavar="DIR",
+        help="persistent XLA compile cache directory to populate "
+             "(same semantics as `start --compile-cache`)",
     )
     g.set_defaults(fn=cmd_warmup)
 
@@ -640,9 +666,20 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--cache-size", type=int, default=4096,
                    help="verified-round LRU entries")
     g.add_argument(
+        "--client-max-inflight", type=int, default=None,
+        help="per-client in-flight cap for identified callers (default "
+             "3/4 of --max-queue); beyond it HTTP 429 with reason "
+             "client_quota",
+    )
+    g.add_argument(
         "--backend", choices=["auto", "ref", "jax", "native"],
         default=os.environ.get("DRAND_TPU_BACKEND", "auto"),
         help="crypto backend (same semantics as `start --backend`)",
+    )
+    g.add_argument(
+        "--compile-cache", metavar="DIR",
+        help="persistent XLA compile cache directory "
+             "(same semantics as `start --compile-cache`)",
     )
     g.set_defaults(fn=cmd_verify_serve)
 

@@ -17,9 +17,10 @@ Everything is jit/vmap-compatible with static shapes: scalar loops are
 sweeps, there is no data-dependent control flow.
 
 Importing this package enables JAX's persistent compilation cache (set
-``DRAND_TPU_XLA_CACHE`` to relocate it, or to ``off`` to disable): the
-pairing pipeline costs minutes of XLA compile time per shape on a small
-host but milliseconds to reload from cache.
+``DRAND_TPU_COMPILE_CACHE`` — or the older ``DRAND_TPU_XLA_CACHE`` — to
+relocate it, or to ``off`` to disable): the pairing pipeline costs
+minutes of XLA compile time per shape on a small host but milliseconds
+to reload from cache.
 
 Every entry point is dispatched through ``obs.kernels.kernel_span`` by
 the crypto backends (crypto/tbls.py): block-until-ready wall timings with
@@ -35,13 +36,35 @@ INSTRUMENTED_KERNELS = ("pairing_check", "msm_recover", "g2_sign", "h2c")
 
 import jax as _jax
 
-_cache = _os.environ.get("DRAND_TPU_XLA_CACHE", "")
-if _cache != "off":
-    if not _cache:
-        _cache = _os.path.join(
+
+def configure_compile_cache(path=None):
+    """Point JAX's persistent compilation cache at a directory.
+
+    Resolution order: explicit `path` argument, then
+    ``DRAND_TPU_COMPILE_CACHE`` (the documented operator knob), then
+    ``DRAND_TPU_XLA_CACHE`` (the original name, kept for compat), then
+    ``~/.cache/drand_tpu_xla``.  The value ``off`` disables the cache.
+    Returns the directory in use, or None when disabled.
+
+    Runs once at package import, and again from `JaxScheme.__init__` /
+    `cli.py --compile-cache` so an env var or flag set after this module
+    was first imported still takes effect before anything compiles —
+    the multi-minute Mosaic/XLA compiles are then paid once per host,
+    not once per process.
+    """
+    cache = path or _os.environ.get("DRAND_TPU_COMPILE_CACHE", "") \
+        or _os.environ.get("DRAND_TPU_XLA_CACHE", "")
+    if cache == "off":
+        return None
+    if not cache:
+        cache = _os.path.join(
             _os.path.expanduser("~"), ".cache", "drand_tpu_xla"
         )
-    _os.makedirs(_cache, exist_ok=True)
-    _jax.config.update("jax_compilation_cache_dir", _cache)
+    _os.makedirs(cache, exist_ok=True)
+    _jax.config.update("jax_compilation_cache_dir", cache)
     _jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache
+
+
+COMPILE_CACHE_DIR = configure_compile_cache()

@@ -19,6 +19,7 @@ unbounded queueing latency.  See README.md "Verification gateway".
 from drand_tpu.serve.batcher import BatchItem, BatchScheduler
 from drand_tpu.serve.cache import VerifiedRoundCache
 from drand_tpu.serve.gateway import (
+    ClientQuota,
     DeadlineExceeded,
     GatewayClosed,
     GatewayError,
@@ -32,6 +33,7 @@ from drand_tpu.serve.gateway import (
 __all__ = [
     "BatchItem",
     "BatchScheduler",
+    "ClientQuota",
     "DeadlineExceeded",
     "GatewayClosed",
     "GatewayError",

@@ -13,11 +13,19 @@ on one compiled shape bucket (tbls.JaxScheme._bucket pads the rest).
 Admission control is the queue bound: `submit` raises
 `asyncio.QueueFull` (translated to an explicit 429/RESOURCE_EXHAUSTED
 by the gateway) instead of queueing unbounded latency.
+
+Fairness: with a `key_of` callable the scheduler keeps one FIFO lane
+per key (per client) and assembles batches by round-robin over the
+lanes — a client flooding a thousand requests no longer pushes every
+other caller's work to the back of one global FIFO; the bounded queue
+then only enforces the TOTAL backlog (per-key bounds are the gateway's
+in-flight cap).
 """
 
 from __future__ import annotations
 
 import asyncio
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, List, Optional
 
@@ -44,6 +52,10 @@ class BatchItem:
     #: callback stamps batch links onto it so a request's trace shows
     #: which kernel batch served it
     span: object = None
+    #: opaque caller identity (None for anonymous in-process callers);
+    #: the scheduler's `key_of` and the gateway's per-client in-flight
+    #: accounting both read it
+    client: Optional[str] = None
 
 
 class BatchScheduler:
@@ -52,7 +64,8 @@ class BatchScheduler:
 
     def __init__(self, flush: Callable[[List[BatchItem]], Awaitable[None]],
                  *, max_batch: int = 128, max_wait: float = 0.005,
-                 max_queue: int = 1024):
+                 max_queue: int = 1024,
+                 key_of: Optional[Callable[[BatchItem], object]] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if max_queue < 1:
@@ -60,7 +73,12 @@ class BatchScheduler:
         self._flush = flush
         self.max_batch = max_batch
         self.max_wait = max_wait
-        self._queue: "asyncio.Queue[BatchItem]" = asyncio.Queue(
+        # With key_of, the asyncio.Queue holds one token per queued item
+        # (preserving the bounded-admission and wakeup semantics) while
+        # the items themselves sit in per-key lanes consumed round-robin.
+        self._key_of = key_of
+        self._lanes: "OrderedDict[object, deque]" = OrderedDict()
+        self._queue: "asyncio.Queue[Optional[BatchItem]]" = asyncio.Queue(
             maxsize=max_queue
         )
         self._task: Optional[asyncio.Task] = None
@@ -77,7 +95,13 @@ class BatchScheduler:
         admission must never itself wait behind the backlog."""
         if self._closed:
             raise RuntimeError("scheduler is closed")
-        self._queue.put_nowait(item)
+        if self._key_of is None:
+            self._queue.put_nowait(item)
+            return
+        # reserve a slot in the bounded queue first — QueueFull sheds
+        # here before the item touches any lane
+        self._queue.put_nowait(None)
+        self._lanes.setdefault(self._key_of(item), deque()).append(item)
 
     # -- consumer loop -----------------------------------------------------
 
@@ -97,22 +121,49 @@ class BatchScheduler:
             self._task = None
         while not self._queue.empty():
             item = self._queue.get_nowait()
-            if not item.future.done():
+            if item is not None and not item.future.done():
                 item.future.set_exception(
                     RuntimeError("scheduler closed")
                 )
+        for lane in self._lanes.values():
+            for item in lane:
+                if not item.future.done():
+                    item.future.set_exception(
+                        RuntimeError("scheduler closed")
+                    )
+        self._lanes.clear()
+
+    def _pop_lane(self) -> BatchItem:
+        """Take the head of the least-recently-served lane and rotate it
+        to the back — one item per lane per turn is the whole fairness
+        policy.  Invariant: tokens in the queue == items across lanes,
+        so a lane item always exists here."""
+        while True:
+            key, lane = next(iter(self._lanes.items()))
+            if not lane:  # defensive: drop empty lane, keep looking
+                del self._lanes[key]
+                continue
+            item = lane.popleft()
+            if lane:
+                self._lanes.move_to_end(key)
+            else:
+                del self._lanes[key]
+            return item
+
+    def _take(self, token: Optional[BatchItem]) -> BatchItem:
+        return token if self._key_of is None else self._pop_lane()
 
     async def _collect(self) -> List[BatchItem]:
         """One batch: first item blocks; then fill until max_batch or
         max_wait past the first arrival, whichever comes first."""
         loop = asyncio.get_event_loop()
         first = await self._queue.get()
-        batch = [first]
+        batch = [self._take(first)]
         flush_at = loop.time() + self.max_wait
         while len(batch) < self.max_batch:
             # drain whatever is already queued without touching timers
             try:
-                batch.append(self._queue.get_nowait())
+                batch.append(self._take(self._queue.get_nowait()))
                 continue
             except asyncio.QueueEmpty:
                 pass
@@ -120,9 +171,9 @@ class BatchScheduler:
             if remaining <= 0:
                 break
             try:
-                batch.append(
+                batch.append(self._take(
                     await asyncio.wait_for(self._queue.get(), remaining)
-                )
+                ))
             except asyncio.TimeoutError:
                 break
         return batch

@@ -18,10 +18,18 @@ device is busy.
 Failure semantics are explicit, never silent latency:
 * queue full            -> `Overloaded`       (REST 429 / gRPC
                            RESOURCE_EXHAUSTED)
+* per-client in-flight
+  cap reached           -> `ClientQuota`      (an Overloaded subtype:
+                           one flooding identity can no longer
+                           monopolise the queue)
 * deadline passed while
   queued                -> `DeadlineExceeded` (rejected at batch
                            assembly — a late verdict is never served)
 * gateway closed        -> `GatewayClosed`
+
+Identified clients additionally get round-robin batch assembly (one
+lane per client in the BatchScheduler), so a burst from one caller
+interleaves with — instead of serializing ahead of — everyone else.
 """
 
 from __future__ import annotations
@@ -72,7 +80,7 @@ _shed = {
         "requests rejected instead of served late",
         labels={"reason": reason},
     )
-    for reason in ("queue_full", "deadline", "oversize")
+    for reason in ("queue_full", "deadline", "oversize", "client_quota")
 }
 _requests = {
     result: metrics.counter(
@@ -115,6 +123,21 @@ class GatewayError(Exception):
 
 class Overloaded(GatewayError):
     """Admission control shed the request (queue at capacity)."""
+
+
+class ClientQuota(Overloaded):
+    """One client exceeded its in-flight cap.  Subclasses Overloaded so
+    the REST 429 / gRPC RESOURCE_EXHAUSTED mappings apply unchanged —
+    the distinction is visible in the shed counters (`client_quota`) and
+    the message, which tells the caller THEY are the source of load."""
+
+    def __init__(self, client: str, cap: int):
+        super().__init__(
+            f"client {client!r} has {cap} verifications in flight "
+            f"(per-client cap); retry after some complete"
+        )
+        self.client = client
+        self.cap = cap
 
 
 class DeadlineExceeded(GatewayError):
@@ -182,16 +205,25 @@ class VerifyGateway:
     def __init__(self, dist_key, scheme: Optional[tbls.Scheme] = None, *,
                  max_batch: int = 128, max_wait: float = 0.005,
                  max_queue: int = 1024, cache_size: int = 4096,
-                 default_timeout: float = 5.0):
+                 default_timeout: float = 5.0,
+                 client_max_inflight: Optional[int] = None):
         if isinstance(dist_key, (bytes, bytearray)):
             dist_key = ref.g1_from_bytes(bytes(dist_key))
         self.dist_key = dist_key
         self.scheme = scheme or tbls.default_scheme()
         self.default_timeout = default_timeout
         self.cache = VerifiedRoundCache(cache_size)
+        # anonymous callers share only the global queue bound; identified
+        # clients additionally get this in-flight cap (default: 3/4 of
+        # the queue, so one identity can never fill it alone)
+        self.client_max_inflight = (
+            client_max_inflight if client_max_inflight is not None
+            else max(1, max_queue * 3 // 4)
+        )
+        self._client_inflight: Dict[str, int] = {}
         self._batcher = BatchScheduler(
             self._flush, max_batch=max_batch, max_wait=max_wait,
-            max_queue=max_queue,
+            max_queue=max_queue, key_of=lambda item: item.client,
         )
         #: key -> BatchItem for claims already queued: identical claims
         #: share one kernel slot and one verdict
@@ -229,6 +261,7 @@ class VerifyGateway:
             if not item.future.done():
                 item.future.set_exception(GatewayClosed("gateway closed"))
         self._inflight.clear()
+        self._client_inflight.clear()
         if self._executor is not None:
             self._executor.shutdown(wait=False)
             self._executor = None
@@ -261,11 +294,12 @@ class VerifyGateway:
         with obs_trace.TRACER.span(
             "gateway.verify", trace_id=trace_id or None, attrs=attrs,
         ) as span:
-            return await self._verify_inner(req, timeout, span)
+            return await self._verify_inner(req, timeout, span, client)
 
     async def _verify_inner(self, req: VerifyRequest,
                             timeout: Optional[float],
-                            span) -> VerifyResult:
+                            span, client: Optional[str] = None
+                            ) -> VerifyResult:
         n = max(len(req.signature), len(req.prev_sig))
         if n > tbls.SIG_LEN:
             _shed["oversize"].inc()
@@ -296,9 +330,17 @@ class VerifyGateway:
             if timeout <= 0:
                 _shed["deadline"].inc()
                 raise DeadlineExceeded("deadline expired before admission")
+            if (client is not None
+                    and self._client_inflight.get(client, 0)
+                    >= self.client_max_inflight):
+                _shed["client_quota"].inc()
+                obs_flight.RECORDER.record("shed", reason="client_quota",
+                                           round=req.round, client=client)
+                raise ClientQuota(client, self.client_max_inflight)
             item = BatchItem(payload=req, deadline=deadline,
                              future=loop.create_future(),
-                             span=obs_trace.TRACER.current())
+                             span=obs_trace.TRACER.current(),
+                             client=client)
             # every waiter may abandon the slot (wait_for timeout); mark
             # a late exception as retrieved so GC never logs noise
             item.future.add_done_callback(_consume_exception)
@@ -312,6 +354,16 @@ class VerifyGateway:
                     f"verification queue full "
                     f"({self._batcher._queue.maxsize} deep); retry later"
                 ) from None
+            if client is not None:
+                self._client_inflight[client] = (
+                    self._client_inflight.get(client, 0) + 1
+                )
+                # "in flight" ends when the verdict (or error) lands —
+                # tying the release to future resolution covers every
+                # path: demux, deadline drop, flush fault, close
+                item.future.add_done_callback(
+                    lambda _f, c=client: self._dec_client(c)
+                )
             self._inflight[key] = item
             _queue_depth.inc()
         # outer wait_for is a backstop for coalesced waiters whose own
@@ -349,12 +401,25 @@ class VerifyGateway:
             "max_batch": self._batcher.max_batch,
             "max_wait": self._batcher.max_wait,
             "inflight": len(self._inflight),
+            "client_max_inflight": self.client_max_inflight,
+            "clients_inflight": dict(self._client_inflight),
             "cache_entries": len(self.cache),
             "cache_hit_rate": (self._hits / total) if total else None,
             "closed": self._closed,
         }
 
     # -- batch flush (BatchScheduler callback) -----------------------------
+
+    def _dec_client(self, client: Optional[str]) -> None:
+        """Release one unit of a client's in-flight quota (no-op for
+        anonymous items)."""
+        if client is None:
+            return
+        left = self._client_inflight.get(client, 0) - 1
+        if left <= 0:
+            self._client_inflight.pop(client, None)
+        else:
+            self._client_inflight[client] = left
 
     def _run_kernel(self, msgs: List[bytes],
                     sigs: List[bytes]) -> List[bool]:

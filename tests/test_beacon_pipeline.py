@@ -1,0 +1,171 @@
+"""Pipelined catch-up: the prefetch of batch k+1 overlaps the device
+verify of batch k, and the stored chain is identical to the source.
+
+Fast tier: the scheme is a recording stub whose `verify_chain_batch`
+just sleeps in the worker thread (standing in for a device dispatch),
+so the test observes the OVERLAP — peer yields for the next segment
+timestamped before the current segment's verify completes — without
+compiling anything.
+"""
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from drand_tpu.beacon import (
+    Beacon,
+    BeaconConfig,
+    BeaconHandler,
+    BeaconStore,
+    beacon_message,
+    genesis_beacon,
+)
+from drand_tpu.beacon.handler import ProtocolClient
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.poly import PriPoly
+from drand_tpu.key import Group, Pair, Share
+from drand_tpu.utils.clock import FakeClock
+
+VERIFY_SECONDS = 0.15
+YIELD_SECONDS = 0.005
+
+
+class RecordingScheme(tbls.Scheme):
+    """verify_chain_batch stub: sleeps like a device dispatch, records
+    (event, payload, monotonic time), verdict via an injectable
+    predicate (default: everything valid)."""
+
+    def __init__(self, events, verdict=None):
+        self.events = events
+        self.verdict = verdict or (lambda rounds: [True] * len(rounds))
+        self.batches = []
+
+    def verify_chain_batch(self, pub_key, msgs, sigs):
+        n = len(msgs)
+        self.events.append(("verify_start", n, time.monotonic()))
+        time.sleep(VERIFY_SECONDS)
+        self.batches.append(n)
+        out = self.verdict(list(range(len(sigs))))
+        self.events.append(("verify_end", n, time.monotonic()))
+        return out
+
+
+class SlowPeerClient(ProtocolClient):
+    """Serves a prebuilt chain over an artificially slow stream and
+    timestamps every yield."""
+
+    def __init__(self, chain, events):
+        self.chain = chain
+        self.events = events
+
+    async def sync_chain(self, peer, from_round):
+        for b in self.chain:
+            if b.round < from_round:
+                continue
+            await asyncio.sleep(YIELD_SECONDS)
+            self.events.append(("yield", b.round, time.monotonic()))
+            yield b
+
+
+def _fake_chain(seed: bytes, n: int):
+    """Chain-linked beacons with opaque (stub-verified) signatures."""
+    chain = [genesis_beacon(seed)]
+    for r in range(1, n + 1):
+        prev = chain[-1]
+        sig = b"sig-%04d" % r + b"\x00" * 88
+        chain.append(Beacon(round=r, prev_round=prev.round,
+                            prev_sig=prev.signature, signature=sig))
+    return chain
+
+
+def _mk_handler(scheme, client, sync_batch=8):
+    r = random.Random(11)
+    clock = FakeClock()
+    pairs = [Pair.generate(f"127.0.0.1:{9100 + i}", rng=r.randbytes)
+             for i in range(2)]
+    group = Group(nodes=[p.public for p in pairs], threshold=2,
+                  period=30.0, genesis_time=int(clock.now()) + 10)
+    poly = PriPoly.random(2, rng=r.randbytes)
+    share = Share(commits=poly.commit().commits, share=poly.eval(0))
+    cfg = BeaconConfig(group=group, public=pairs[0].public, share=share,
+                       scheme=scheme, clock=clock, sync_batch=sync_batch)
+    handler = BeaconHandler(cfg, BeaconStore(), client)
+    return handler, group, pairs[1].public
+
+
+async def test_pipelined_sync_overlaps_fetch_with_verify():
+    events = []
+    scheme = RecordingScheme(events)
+    handler, group, peer = _mk_handler(scheme, None, sync_batch=8)
+    chain = _fake_chain(group.get_genesis_seed(), 32)
+    handler.client = SlowPeerClient(chain, events)
+    handler._ensure_genesis()
+
+    await handler._sync_from(peer)
+
+    # identical stored chain: every synced beacon, bit for bit
+    stored = handler.store.range_from(0)
+    assert [(b.round, b.prev_round, b.prev_sig, b.signature)
+            for b in stored] == \
+        [(b.round, b.prev_round, b.prev_sig, b.signature) for b in chain]
+    assert scheme.batches == [8, 8, 8, 8]
+
+    # the overlap: some beacon of segment TWO (rounds 9..16) was yielded
+    # by the peer BEFORE segment one's verify completed on "device"
+    first_end = next(t for kind, _, t in events if kind == "verify_end")
+    overlapped = [rnd for kind, rnd, t in events
+                  if kind == "yield" and 8 < rnd <= 16 and t < first_end]
+    assert overlapped, (
+        "no prefetch overlap: batch 2 only streamed after batch 1's "
+        f"verify finished ({events[:8]}...)"
+    )
+
+
+async def test_pipelined_sync_serial_equivalence_small_tail():
+    """A chain that is not a multiple of the batch size stores fully:
+    the final short segment flows through the same pipeline."""
+    events = []
+    scheme = RecordingScheme(events)
+    handler, group, peer = _mk_handler(scheme, None, sync_batch=8)
+    chain = _fake_chain(group.get_genesis_seed(), 19)
+    handler.client = SlowPeerClient(chain, events)
+    handler._ensure_genesis()
+    await handler._sync_from(peer)
+    assert handler.store.last().round == 19
+    assert scheme.batches == [8, 8, 3]
+
+
+async def test_pipelined_sync_failure_cancels_prefetch_cleanly():
+    """An invalid signature mid-stream: the error propagates, nothing
+    past the failed segment is stored, and the in-flight prefetch is
+    cancelled (its exception never surfaces as an orphaned task)."""
+    events = []
+
+    def verdict_factory(scheme_holder):
+        def verdict(idxs):
+            # batches is appended before the verdict runs, so ==1 means
+            # this is the first segment; later segments fail row 3
+            first_call = len(scheme_holder[0].batches) == 1
+            return [True] * len(idxs) if first_call else \
+                [i != 3 for i in idxs]
+        return verdict
+
+    holder = [None]
+    scheme = RecordingScheme(events, verdict_factory(holder))
+    holder[0] = scheme
+    handler, group, peer = _mk_handler(scheme, None, sync_batch=8)
+    chain = _fake_chain(group.get_genesis_seed(), 32)
+    handler.client = SlowPeerClient(chain, events)
+    handler._ensure_genesis()
+
+    with pytest.raises(ValueError, match="invalid signatures"):
+        await handler._sync_from(peer)
+    # only the first (valid) segment landed
+    assert handler.store.last().round == 8
+    # give cancelled tasks a tick; no pending sync tasks may remain
+    await asyncio.sleep(0.05)
+    pending = [t for t in asyncio.all_tasks()
+               if t is not asyncio.current_task() and not t.done()]
+    assert not pending, pending

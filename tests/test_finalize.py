@@ -1,0 +1,240 @@
+"""Fused round finalize: plan cache, per-round hash cache, equivalence
+with the oracle recovery, and the <= 2-device-dispatch guarantee.
+
+Fast tier covers the pure-host cache mechanics (no pairing compile is
+triggered: operand encoding is element-wise jnp work).  The fused
+pipeline itself — XLA-compiling the op-graph pairing — carries
+@pytest.mark.slow, same policy as tests/test_tbls.py.
+"""
+
+import random
+
+import pytest
+
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.poly import PriPoly
+
+slow = pytest.mark.slow
+
+MSG = b"drand-tpu finalize round message"
+
+
+def fixed_group(t, seed):
+    r = random.Random(seed)
+    return PriPoly.random(t, rng=r.randbytes)
+
+
+def _count_evals(pub):
+    """Wrap pub.eval with a per-instance counter; returns the counter
+    holder (mutated in place)."""
+    calls = {"n": 0}
+    orig = pub.eval
+
+    def counting(index):
+        calls["n"] += 1
+        return orig(index)
+
+    pub.eval = counting
+    return calls
+
+
+# -- base-scheme contract (runs on the oracle: fast) ------------------------
+
+
+def test_base_finalize_round_contract():
+    """The Scheme-level finalize_round (recover + verify_recovered)
+    returns the same signature as the explicit two-step path, and
+    raises below the threshold."""
+    scheme = tbls.RefScheme()
+    t, n = 2, 3
+    poly = fixed_group(t, 71)
+    pub = poly.commit()
+    partials = [scheme.partial_sign(s, MSG) for s in poly.shares(n)]
+    sig = scheme.finalize_round(pub, MSG, partials, t, n)
+    assert sig == scheme.recover(pub, MSG, partials, t, n)
+    scheme.verify_recovered(pub.commit(), MSG, sig)
+    with pytest.raises(tbls.ThresholdError):
+        scheme.finalize_round(pub, MSG, partials[:t - 1], t, n)
+
+
+# -- plan cache mechanics (host-side: fast) ---------------------------------
+
+
+def test_plan_cache_zero_host_work_on_repeat():
+    """Second and subsequent touches of the same committee layout do
+    zero host polynomial evaluations and zero operand re-encoding —
+    the steady-state round is a pure dict hit."""
+    scheme = tbls.JaxScheme()
+    t, n = 2, 4
+    poly = fixed_group(t, 72)
+    pub = poly.commit()
+    calls = _count_evals(pub)
+
+    plan = scheme._plan(pub)
+    assert plan.encode_calls == 1          # −G + collective key, once
+    rows = list(range(n))
+    a1 = scheme._pk_stack(pub, plan, rows)
+    assert calls["n"] == n                 # each signer evaluated once
+    encodes_after_first = plan.encode_calls
+
+    # warm rounds: same layout -> same array object, no new host work
+    for _ in range(3):
+        a2 = scheme._pk_stack(pub, plan, rows)
+        assert a2 is a1
+    assert calls["n"] == n
+    assert plan.encode_calls == encodes_after_first
+    assert plan.stack_hits == 3
+    assert plan.host_evals == n
+
+    # a different layout re-stacks but re-evaluates nothing
+    scheme._pk_stack(pub, plan, [1, 0, 1, 0])
+    assert calls["n"] == n
+
+    # the plan survives on the PubPoly object itself
+    assert scheme._plan(pub) is plan
+
+
+def test_plan_cache_invalidated_by_fresh_pubpoly():
+    """A reshare hands the daemon a NEW PubPoly: it must get its own
+    plan (fresh operands), leaving the old committee's untouched."""
+    scheme = tbls.JaxScheme()
+    old = fixed_group(2, 73).commit()
+    new = fixed_group(2, 74).commit()
+    p_old = scheme._plan(old)
+    p_new = scheme._plan(new)
+    assert p_old is not p_new
+    assert scheme._plan(old) is p_old
+
+
+def test_eval_pub_memoized_independent_of_plan():
+    scheme = tbls.JaxScheme()
+    pub = fixed_group(2, 75).commit()
+    calls = _count_evals(pub)
+    first = scheme._eval_pub(pub, 3)
+    assert scheme._eval_pub(pub, 3) == first
+    assert calls["n"] == 1
+
+
+def test_msg_hash_cached_across_consumers():
+    """H(m) is computed once per round message and shared; a different
+    message misses.  The hash itself is stubbed — computing it would
+    XLA-compile hash-to-curve, which belongs to the slow tier."""
+    scheme = tbls.JaxScheme()
+    hashed = []
+
+    def fake_hash(msgs):
+        hashed.extend(msgs)
+        return object()  # stands in for the device array
+
+    scheme._hash_msgs = fake_hash
+    q1 = scheme._msg_q2(b"round-1")
+    assert scheme._msg_q2(b"round-1") is q1
+    scheme._msg_q2(b"round-2")
+    assert hashed == [b"round-1", b"round-2"]
+    assert scheme._msg_hits == 1
+
+
+# -- compile-cache wiring (host-side: fast) ---------------------------------
+
+
+def test_configure_compile_cache_env(tmp_path, monkeypatch):
+    import jax
+
+    from drand_tpu import ops
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        target = tmp_path / "xla-cache"
+        monkeypatch.setenv("DRAND_TPU_COMPILE_CACHE", str(target))
+        got = ops.configure_compile_cache()
+        assert got == str(target)
+        assert target.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(target)
+        # explicit path beats the env var (cli --compile-cache)
+        other = tmp_path / "other"
+        assert ops.configure_compile_cache(str(other)) == str(other)
+        # "off" disables
+        monkeypatch.setenv("DRAND_TPU_COMPILE_CACHE", "off")
+        assert ops.configure_compile_cache() is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+# -- fused pipeline (XLA compile: slow tier) --------------------------------
+
+
+@slow
+def test_fused_finalize_matrix_and_dispatches():
+    """Equivalence matrix vs the oracle + the dispatch-count guarantee.
+
+    The fused output must be byte-identical to RefScheme.recover over
+    the valid subset for: exactly-t, a flood of n>t partials, duplicate
+    indices, and malformed/invalid partials interleaved with good ones;
+    sub-threshold inputs raise.  A warm finalize must issue at most two
+    device dispatches (pairing_check + fused msm_recover) and zero host
+    polynomial evaluations."""
+    from drand_tpu.obs import trace as obs_trace
+
+    rscheme = tbls.RefScheme()
+    jscheme = tbls.JaxScheme()
+    t, n = 2, 4
+    poly = fixed_group(t, 76)
+    pub = poly.commit()
+    shares = poly.shares(n)
+    p = [rscheme.partial_sign(s, MSG) for s in shares]
+
+    bad_sig = p[3][:-1] + bytes([p[3][-1] ^ 0x01])
+    malformed = b"\x00\x01" + b"\xff" * 96
+
+    cases = [
+        (p[:t], p[:t]),                              # exactly t
+        (p, p),                                      # flood, n > t
+        ([p[0], p[0], p[1], p[1]], [p[0], p[1]]),    # duplicate indices
+        ([malformed, p[2], bad_sig, b"junk", p[0]],  # garbage interleaved
+         [p[2], p[0]]),
+    ]
+    for partials, valid_subset in cases:
+        want = rscheme.recover(pub, MSG, valid_subset, t, n)
+        got = jscheme.finalize_round(pub, MSG, partials, t, n)
+        assert got == want, partials
+        rscheme.verify_recovered(pub.commit(), MSG, got)
+
+    # below threshold: one good partial + one invalid, or all garbage
+    with pytest.raises(tbls.ThresholdError):
+        jscheme.finalize_round(pub, MSG, [p[0], bad_sig], t, n)
+    with pytest.raises(tbls.ThresholdError):
+        jscheme.finalize_round(pub, MSG, [malformed], t, n)
+
+    # -- dispatch count + zero-host-work on the warm path -----------------
+    if not obs_trace.TRACER.enabled:
+        pytest.skip("tracer disabled (DRAND_TPU_TRACE=off)")
+    plan = pub._jax_plan
+    calls = _count_evals(pub)
+    encodes = plan.encode_calls
+    hits = plan.stack_hits
+    with obs_trace.TRACER.span("test.finalize") as sp:
+        jscheme.finalize_round(pub, MSG, p, t, n)
+    tr = obs_trace.TRACER.get_trace(sp.trace_id)
+    kernels = [s["name"] for s in tr["spans"]
+               if s["name"].startswith("kernel.")]
+    assert len(kernels) <= 2, kernels
+    assert set(kernels) == {"kernel.pairing_check", "kernel.msm_recover"}
+    assert calls["n"] == 0                 # zero host polynomial evals
+    assert plan.encode_calls == encodes    # zero operand re-encoding
+    assert plan.stack_hits > hits
+
+
+@slow
+def test_fused_finalize_matches_master_secret_signature():
+    """End to end: the fused signature equals signing with the master
+    secret, via jax partials this time (sign path shares the hash
+    cache)."""
+    jscheme = tbls.JaxScheme()
+    t, n = 2, 3
+    poly = fixed_group(t, 77)
+    pub = poly.commit()
+    partials = [jscheme.partial_sign(s, MSG) for s in poly.shares(n)]
+    sig = jscheme.finalize_round(pub, MSG, partials, t, n)
+    h = ref.hash_to_g2(MSG)
+    assert sig == ref.g2_to_bytes(ref.g2_mul(h, poly.secret()))

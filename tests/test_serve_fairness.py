@@ -1,0 +1,155 @@
+"""Gateway per-client fairness: in-flight caps and round-robin lanes.
+
+Fast tier, stub crypto backend (same idiom as tests/test_serve.py):
+what these pin down is the ADMISSION policy — a flooding identified
+client is shed with `client_quota` while everyone else keeps serving,
+and batch assembly interleaves clients instead of serving one caller's
+burst ahead of all later arrivals.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+import pytest
+
+from drand_tpu.serve import (
+    ClientQuota,
+    Overloaded,
+    VerifyGateway,
+    VerifyRequest,
+)
+
+
+class StubScheme:
+    """Verdict = signature starts with b'ok'; records every batch."""
+
+    def __init__(self, gate: threading.Event = None):
+        self.batches = []
+        self.gate = gate
+
+    def verify_chain_batch(self, pub, msgs, sigs):
+        if self.gate is not None:
+            assert self.gate.wait(10.0), "test gate never released"
+        self.batches.append(list(msgs))
+        return [sig.startswith(b"ok") for sig in sigs]
+
+
+def req(round: int, valid: bool = True) -> VerifyRequest:
+    sig = (b"ok" if valid else b"no") + round.to_bytes(8, "big")
+    return VerifyRequest(round=round, prev_round=round - 1,
+                         prev_sig=b"\x01" * 96, signature=sig)
+
+
+def gateway(scheme=None, **kw) -> VerifyGateway:
+    kw.setdefault("max_wait", 0.02)
+    return VerifyGateway(object(), scheme or StubScheme(), **kw)
+
+
+async def test_flooding_client_hits_quota_others_still_admitted():
+    """One identified client at its in-flight cap gets ClientQuota;
+    a different client and an anonymous caller are still admitted."""
+    gate = threading.Event()
+    scheme = StubScheme(gate)
+    async with gateway(scheme, max_queue=16, client_max_inflight=3) as gw:
+        flood = [
+            asyncio.create_task(gw.verify(req(r), client="noisy"))
+            for r in range(1, 4)
+        ]
+        await asyncio.sleep(0.05)  # let the three occupy their slots
+        with pytest.raises(ClientQuota):
+            await gw.verify(req(99), client="noisy")
+        # ClientQuota is an Overloaded subtype: REST/gRPC mappings hold
+        assert issubclass(ClientQuota, Overloaded)
+        # other identities and anonymous callers are unaffected
+        others = [
+            asyncio.create_task(gw.verify(req(50), client="quiet")),
+            asyncio.create_task(gw.verify(req(51))),
+        ]
+        await asyncio.sleep(0.05)
+        stats = gw.stats()
+        assert stats["clients_inflight"]["noisy"] == 3
+        assert stats["client_max_inflight"] == 3
+        gate.set()
+        results = await asyncio.gather(*flood, *others)
+        assert all(r.valid for r in results)
+    # quota released once the batches flushed
+    assert gw.stats()["clients_inflight"] == {}
+
+
+async def test_quota_released_after_flush_admits_again():
+    scheme = StubScheme()
+    async with gateway(scheme, client_max_inflight=1) as gw:
+        r1 = await gw.verify(req(1), client="c")
+        # the slot was released at flush: the next request is admitted
+        r2 = await gw.verify(req(2), client="c")
+    assert r1.valid and r2.valid
+
+
+async def test_anonymous_clients_unlimited_by_quota():
+    """Anonymous callers share only the global queue bound — the
+    per-client cap never applies to them."""
+    gate = threading.Event()
+    scheme = StubScheme(gate)
+    async with gateway(scheme, max_queue=16,
+                       client_max_inflight=1) as gw:
+        tasks = [asyncio.create_task(gw.verify(req(r)))
+                 for r in range(1, 6)]
+        await asyncio.sleep(0.05)
+        gate.set()
+        results = await asyncio.gather(*tasks)
+    assert all(r.valid for r in results)
+
+
+async def test_round_robin_interleaves_clients_in_batch():
+    """A noisy burst of 6 and a quiet pair enqueued after it: with
+    max_batch=4 the first batch assembled from that backlog must
+    contain BOTH quiet requests (round-robin lanes), not the first
+    four noisy ones (global FIFO would starve quiet to the next batch).
+
+    A primer request holds the consumer inside a gated flush so the
+    whole backlog is queued before any of it is collected."""
+    gate = threading.Event()
+    scheme = StubScheme(gate)
+    async with gateway(scheme, max_batch=4, max_wait=0.05,
+                       max_queue=32) as gw:
+        primer = asyncio.create_task(gw.verify(req(100)))
+        await asyncio.sleep(0.05)  # primer batch now blocked in flush
+        noisy = [asyncio.create_task(
+            gw.verify(req(r), client="noisy")) for r in range(1, 7)]
+        await asyncio.sleep(0)  # enqueue order: all noisy first
+        quiet = [asyncio.create_task(
+            gw.verify(req(r), client="quiet")) for r in range(50, 52)]
+        await asyncio.sleep(0.02)
+        gate.set()
+        await asyncio.gather(primer, *noisy, *quiet)
+    assert scheme.batches[0] == [req(100).message()]
+    second = scheme.batches[1]
+    assert req(50).message() in second and req(51).message() in second
+
+
+async def test_client_quota_shed_reason_counted():
+    from drand_tpu.utils import metrics
+
+    gate = threading.Event()
+    scheme = StubScheme(gate)
+    async with gateway(scheme, client_max_inflight=1) as gw:
+        t1 = asyncio.create_task(gw.verify(req(1), client="flood"))
+        await asyncio.sleep(0.03)
+        before = metrics.render()
+        with pytest.raises(ClientQuota):
+            await gw.verify(req(2), client="flood")
+        after = metrics.render()
+        gate.set()
+        assert (await t1).valid
+    line = 'drand_serve_shed_total{reason="client_quota"}'
+    assert line in after
+
+    def _value(text):
+        for ln in text.splitlines():
+            if ln.startswith(line):
+                return float(ln.split()[-1])
+        return 0.0
+
+    assert _value(after) == _value(before) + 1
