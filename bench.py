@@ -147,6 +147,18 @@ def select_check_kernel():
     return kernel, jax.jit(pairing.pairing_product_check)
 
 
+def _pcts(values) -> dict:
+    """p50/p95/p99 of a sample (seconds), rounded for the JSON line.
+    Medians alone hid the tail that matters for a deadline-driven round
+    loop; the percentiles are what the SLO engine actually judges."""
+    arr = np.asarray(sorted(values), dtype=float)
+    return {
+        "p50": round(float(np.percentile(arr, 50)), 6),
+        "p95": round(float(np.percentile(arr, 95)), 6),
+        "p99": round(float(np.percentile(arr, 99)), 6),
+    }
+
+
 def _bench_round_finalize() -> dict:
     """Time the fused round-finalize path (partials -> verified
     collective sig) end to end on JaxScheme, and count device dispatches
@@ -177,24 +189,39 @@ def _bench_round_finalize() -> dict:
     # warm: compiles the check + fused MSM programs, fills the plan cache
     scheme.finalize_round(pub, msg, partials, t, n)
 
+    lap_times = []
     with obs_trace.TRACER.span("bench.finalize") as sp:
         t0 = time.perf_counter()
         for _ in range(iters):
+            t_lap = time.perf_counter()
             sig = scheme.finalize_round(pub, msg, partials, t, n)
+            lap_times.append(time.perf_counter() - t_lap)
         dt = time.perf_counter() - t0
     assert len(sig) == tbls.SIG_LEN
     dispatches = None
+    kernel_pcts = None
     if sp.trace_id is not None:
         tr = obs_trace.TRACER.get_trace(sp.trace_id)
         if tr:
             kernels = [s for s in tr["spans"]
                        if s["name"].startswith("kernel.")]
             dispatches = round(len(kernels) / iters, 2)
+            # tail latency per kernel op, from the same spans that
+            # counted the dispatches
+            by_op = {}
+            for s in kernels:
+                if s.get("duration") is not None:
+                    by_op.setdefault(s["name"][len("kernel."):],
+                                     []).append(s["duration"])
+            kernel_pcts = {op: _pcts(ds)
+                           for op, ds in sorted(by_op.items())}
     return {
         "t": t, "n": n, "iters": iters,
         "finalizes_per_sec": round(iters / dt, 1),
         "seconds_per_finalize": round(dt / iters, 5),
+        "finalize_seconds_percentiles": _pcts(lap_times),
         "device_dispatches_per_finalize": dispatches,
+        "kernel_seconds_percentiles": kernel_pcts,
     }
 
 

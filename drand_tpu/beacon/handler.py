@@ -39,6 +39,8 @@ from drand_tpu.beacon.round_cache import RoundManager
 from drand_tpu.beacon.store import BeaconStore, CallbackStore
 from drand_tpu.crypto import tbls
 from drand_tpu.key import Group, Identity, Share
+from drand_tpu.obs import peers as obs_peers
+from drand_tpu.obs import slo as obs_slo
 from drand_tpu.obs import trace as obs_trace
 from drand_tpu.utils import metrics
 from drand_tpu.utils.clock import Clock
@@ -90,6 +92,9 @@ class BeaconPacket:
     #: group member derives the same value, but carrying it on the wire
     #: lets out-of-group observers stitch too (and survives seed drift)
     trace_id: str = ""
+    #: sender's clock at send time (unix seconds; 0 = not carried) — the
+    #: receiver's peer ledger estimates clock skew from recv - sent_at
+    sent_at: float = 0.0
 
     def to_dict(self) -> dict:
         return {
@@ -99,6 +104,7 @@ class BeaconPacket:
             "prev_sig": self.prev_sig.hex(),
             "partial_sig": self.partial_sig.hex(),
             "trace_id": self.trace_id,
+            "sent_at": self.sent_at,
         }
 
     @classmethod
@@ -110,6 +116,7 @@ class BeaconPacket:
             prev_sig=bytes.fromhex(d["prev_sig"]),
             partial_sig=bytes.fromhex(d["partial_sig"]),
             trace_id=d.get("trace_id", ""),
+            sent_at=float(d.get("sent_at", 0.0)),
         )
 
 
@@ -158,6 +165,19 @@ class BeaconHandler:
         #: peer address -> clock time of last VALID partial (liveness
         #: view for /v1/status; never pruned — group size is small)
         self.peer_seen: Dict[str, float] = {}
+        #: per-signer contribution accounting (latency, misses, skew)
+        self.peer_ledger = obs_peers.PeerLedger(
+            (n.address for n in cfg.group.nodes),
+            cfg.public.address, cfg.group.period,
+        )
+        # SLO: the chain's reason to exist is randomness on schedule, so
+        # the objective is phrased against the round's own deadline
+        obs_slo.ENGINE.objective(
+            obs_slo.ROUND_FINALIZE,
+            target=0.99,
+            threshold=0.5 * cfg.group.period,
+            describe="99% of rounds finalize within half the period",
+        )
         self._running = False
         self._stop_at: Optional[int] = None
         self._loop_task: Optional[asyncio.Task] = None
@@ -256,9 +276,15 @@ class BeaconHandler:
             await self._run_round_inner(round)
         except asyncio.CancelledError:
             _rounds_failed.inc()  # ticker-is-king abandonment
+            if self._running:
+                # an abandoned round burned budget; a shutdown didn't
+                obs_slo.ENGINE.record_bad(obs_slo.ROUND_FINALIZE,
+                                          ts=self.clock.now())
             raise
         except Exception:
             _rounds_failed.inc()  # recovery/verification failure
+            obs_slo.ENGINE.record_bad(obs_slo.ROUND_FINALIZE,
+                                      ts=self.clock.now())
             self.log.exception("round failed", round=round)
 
     async def _run_round_inner(self, round: int) -> None:
@@ -303,6 +329,7 @@ class BeaconHandler:
             prev_sig=prev_sig,
             partial_sig=own,
             trace_id=tid,
+            sent_at=self.clock.now(),
         )
         with obs_trace.TRACER.span(
             "beacon.gossip",
@@ -355,6 +382,21 @@ class BeaconHandler:
         _round_seconds.observe(
             asyncio.get_running_loop().time() - t_start
         )
+        now = self.clock.now()
+        # SLO event: latency measured against the round's SCHEDULED open,
+        # not our attempt start — a late start is also a late round
+        obs_slo.ENGINE.observe(
+            obs_slo.ROUND_FINALIZE,
+            now - time_of_round(self.group.period,
+                                self.group.genesis_time, round),
+            ts=now,
+        )
+        # contribution accounting: every signer whose partial is NOT in
+        # the recovered set missed this round
+        self.peer_ledger.round_complete(round, (
+            self.group.nodes[i].address for i in partials
+            if i < len(self.group.nodes)
+        ))
         self.log.debug("round stored", round=round)
         if self._stop_at is not None and round >= self._stop_at:
             self._running = False
@@ -402,6 +444,12 @@ class BeaconHandler:
         ):
             try:
                 self.check_packet_window(packet)
+            except Exception:
+                # stale/ahead packet, not a forged signature: reject it
+                # without charging the sender an "invalid partial"
+                _partials_rejected.inc()
+                raise
+            try:
                 msg = beacon_message(packet.prev_sig, packet.prev_round,
                                      packet.round)
                 # heavy pairing math runs off the event loop so the gRPC
@@ -412,8 +460,19 @@ class BeaconHandler:
                 )
             except Exception:
                 _partials_rejected.inc()
+                self.peer_ledger.record_invalid(
+                    packet.from_address, self.clock.now()
+                )
                 raise
-        self.peer_seen[packet.from_address] = self.clock.now()
+        now = self.clock.now()
+        self.peer_seen[packet.from_address] = now
+        self.peer_ledger.record_partial(
+            packet.from_address, packet.round, ts=now,
+            round_open=time_of_round(self.group.period,
+                                     self.group.genesis_time,
+                                     packet.round),
+            sent_at=packet.sent_at or None,
+        )
         # a valid partial referencing a chain link AHEAD of our head means
         # we missed a round: pull the gap from peers (the reference's
         # recovery is pull-based catch-up, SURVEY §5) so the next round's
@@ -484,19 +543,35 @@ class BeaconHandler:
 
         try:
             batch = await next_batch()
+            batch_index = 0
             while batch:
                 prefetch = asyncio.create_task(next_batch())
-                try:
-                    head = await self._verify_and_store(head, batch)
-                except BaseException:
-                    # a broken link / bad signature must not orphan the
-                    # in-flight prefetch (or leak its exception)
-                    prefetch.cancel()
+                # one span per device batch: the catch-up path becomes a
+                # sequence of beacon.sync spans whose prefetch_overlap
+                # attr says whether the pipeline actually hid the pull
+                with obs_trace.TRACER.span(
+                    "beacon.sync",
+                    attrs={"peer": peer.address, "batch": batch_index,
+                           "size": len(batch),
+                           "from_round": batch[0].round,
+                           "to_round": batch[-1].round},
+                ) as sync_span:
                     try:
-                        await prefetch
+                        head = await self._verify_and_store(head, batch)
                     except BaseException:
-                        pass
-                    raise
+                        # a broken link / bad signature must not orphan
+                        # the in-flight prefetch (or leak its exception)
+                        prefetch.cancel()
+                        try:
+                            await prefetch
+                        except BaseException:
+                            pass
+                        raise
+                    # prefetch already done == the next pull fully
+                    # overlapped this batch's device verify
+                    sync_span.set_attr("prefetch_overlap",
+                                       prefetch.done())
+                batch_index += 1
                 batch = await prefetch
         finally:
             aclose = getattr(stream, "aclose", None)

@@ -20,6 +20,8 @@ and daemon.go/control.go/public.go):
   drand-tpu reset                          wipe beacon + share state
   drand-tpu status                         health snapshot (/v1/status)
   drand-tpu trace <round>                  span tree of one beacon round
+  drand-tpu doctor                         ranked diagnosis from /v1/slo
+                                           + /v1/status + /debug/flight
 
 Run as `python -m drand_tpu.cli ...`.
 """
@@ -570,6 +572,129 @@ def cmd_trace(args) -> int:
     return 0
 
 
+# doctor severity ranks (findings print most severe first)
+_SEV = {"critical": 0, "warning": 1, "info": 2}
+
+
+def diagnose(status, slo_doc, flight_events) -> list:
+    """Pure diagnosis over the three observability documents: returns
+    findings as {severity, kind, summary, detail} dicts ranked most
+    severe first.  Pure so tests (and other front ends) can run it on
+    captured documents without HTTP."""
+    findings = []
+
+    def add(severity, kind, summary, detail=""):
+        findings.append({"severity": severity, "kind": kind,
+                         "summary": summary, "detail": detail})
+
+    status = status or {}
+    slo_doc = slo_doc or {}
+    flight_events = flight_events or []
+
+    # -- chain progress ---------------------------------------------------
+    chain = status.get("chain") or {}
+    head = chain.get("head_round")
+    expected = chain.get("expected_round")
+    if chain:
+        if not chain.get("running"):
+            add("critical", "stalled_chain",
+                "beacon loop is not running",
+                f"chain head is round {head}")
+        elif head is not None and expected is not None \
+                and head + 1 < expected:
+            add("critical", "stalled_chain",
+                f"chain is stalled: head round {head}, clock expects "
+                f"round {expected}",
+                f"{expected - head} round(s) behind — the network is "
+                "not reaching its threshold (check suspects below) or "
+                "this node cannot sync")
+    elif status.get("state") == "waiting for DKG":
+        add("info", "no_chain", "node is waiting for DKG; no chain yet")
+
+    # -- peer health ------------------------------------------------------
+    for s in status.get("suspects") or []:
+        reasons = "; ".join(s.get("reasons") or []) or "composite score"
+        add("warning", "lagging_peer",
+            f"peer {s.get('peer')} is suspect "
+            f"(score {s.get('score')})", reasons)
+
+    # -- SLO burn ---------------------------------------------------------
+    for name, obj in sorted((slo_doc.get("objectives") or {}).items()):
+        for alarm in obj.get("breaching") or []:
+            add("critical", "slo_burn",
+                f"SLO {name} is burning error budget "
+                f"{alarm.get('long_burn')}x over {alarm.get('window')} "
+                f"(alert factor {alarm.get('factor')})",
+                obj.get("description", ""))
+        remaining = obj.get("budget_remaining")
+        if remaining is not None and remaining < 0.25 \
+                and not obj.get("breaching"):
+            add("warning", "slo_budget",
+                f"SLO {name} has {remaining:.0%} error budget left",
+                obj.get("description", ""))
+
+    # -- gateway pressure -------------------------------------------------
+    serve = status.get("serve") or {}
+    depth, max_q = serve.get("queue_depth"), serve.get("max_queue")
+    if depth and max_q and depth >= max_q * 0.8:
+        add("warning", "gateway_pressure",
+            f"verify gateway queue at {depth}/{max_q} — sheds imminent")
+
+    # -- cold compile cache ----------------------------------------------
+    for op, st in sorted((status.get("kernels") or {}).items()):
+        n = st.get("dispatches", 0)
+        first = st.get("first_seconds", 0.0)
+        if n >= 2 and first >= 0.5:
+            steady = (st.get("seconds_total", 0.0) - first) / (n - 1)
+            if first > max(10 * steady, 0.5):
+                add("info", "cold_compile",
+                    f"kernel {op}: first dispatch took {first:.2f}s vs "
+                    f"{steady * 1e3:.1f}ms steady-state — cold XLA "
+                    "compile; pre-warm with `drand-tpu warmup`")
+
+    # -- flight recorder -------------------------------------------------
+    crashes = [e for e in flight_events
+               if e.get("kind") in ("crash", "signal")]
+    if crashes:
+        last = crashes[-1]
+        add("warning", "recent_crash",
+            f"flight recorder holds a {last.get('kind')} event",
+            str({k: v for k, v in last.items() if k != "kind"}))
+
+    if not findings:
+        add("info", "healthy", "no problems detected")
+    findings.sort(key=lambda f: _SEV.get(f["severity"], 3))
+    return findings
+
+
+def cmd_doctor(args) -> int:
+    """Pull the three observability documents and print the ranked
+    diagnosis; exit 1 when anything critical was found."""
+    import json
+
+    base = args.url.rstrip("/")
+    status = _http_get_json(f"{base}/v1/status")
+    slo_doc = _http_get_json(f"{base}/v1/slo")
+    try:
+        flight_doc = _http_get_json(f"{base}/debug/flight")
+    except Exception:
+        flight_doc = []
+    events = (flight_doc.get("events", flight_doc)
+              if isinstance(flight_doc, dict) else flight_doc)
+
+    findings = diagnose(status, slo_doc, events)
+    if args.json:
+        print(json.dumps(findings, indent=2))
+    else:
+        marks = {"critical": "!!", "warning": " !", "info": "  "}
+        for f in findings:
+            print(f"{marks.get(f['severity'], '  ')} "
+                  f"[{f['severity']}] {f['kind']}: {f['summary']}")
+            if f.get("detail"):
+                print(f"       {f['detail']}")
+    return 1 if any(f["severity"] == "critical" for f in findings) else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="drand-tpu",
@@ -735,6 +860,17 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--url", default="http://127.0.0.1:8080",
                    help="REST base URL of the node")
     g.set_defaults(fn=cmd_trace)
+
+    g = sub.add_parser(
+        "doctor",
+        help="ranked diagnosis: stalled chain, lagging peers, SLO "
+             "burn-rate alarms, cold compile cache",
+    )
+    g.add_argument("--url", default="http://127.0.0.1:8080",
+                   help="REST base URL of the node")
+    g.add_argument("--json", action="store_true",
+                   help="print findings as a JSON list")
+    g.set_defaults(fn=cmd_doctor)
     return p
 
 

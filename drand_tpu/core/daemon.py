@@ -272,6 +272,13 @@ class Drand:
 
         return daemon_status(self)
 
+    def slo_json(self) -> dict:
+        """The /v1/slo document, evaluated against the daemon's clock
+        (injectable, so FakeClock tests cross breach boundaries)."""
+        from drand_tpu.obs import slo
+
+        return slo.ENGINE.snapshot(now=self.clock.now())
+
     def _dump_flight(self) -> None:
         """Best-effort flight-recorder dump into the daemon folder, so a
         crash or SIGTERM leaves post-mortem evidence next to the keys."""

@@ -153,14 +153,60 @@ async def handle_verify(gateway, request):
     return web.json_response(_verify_result_json(res))
 
 
-def _add_obs_routes(routes: web.RouteTableDef, status_fn) -> None:
-    """Introspection surface shared by both apps: health JSON, recent
-    traces, and the live flight-recorder buffer."""
-    from drand_tpu.obs import flight, trace
+def _dumps_repr(obj) -> str:
+    import json
+
+    return json.dumps(obj, default=repr)
+
+
+def _profile_authorized(request) -> bool:
+    """`POST /debug/profile` is control-plane surface: device profiling
+    costs real throughput, so it is limited to loopback callers unless
+    the operator set `DRAND_TPU_PROFILE_TOKEN` and the caller presents
+    it in `X-Drand-Profile-Token`."""
+    import os
+
+    token = os.environ.get("DRAND_TPU_PROFILE_TOKEN")
+    if token and request.headers.get("X-Drand-Profile-Token") == token:
+        return True
+    return request.remote in ("127.0.0.1", "::1", "localhost", None)
+
+
+def _add_obs_routes(routes: web.RouteTableDef, status_fn,
+                    slo_fn=None) -> None:
+    """Introspection surface shared by both apps: health JSON, SLO
+    document, recent traces, the live flight-recorder buffer and
+    on-demand device profiling."""
+    from drand_tpu.obs import flight, profile, slo, trace
 
     @routes.get("/v1/status")
     async def status(request):
         return web.json_response(status_fn())
+
+    @routes.get("/v1/slo")
+    async def slo_doc(request):
+        fn = slo_fn or slo.ENGINE.snapshot
+        return web.json_response(fn())
+
+    @routes.post("/debug/profile")
+    async def profile_start(request):
+        if not _profile_authorized(request):
+            raise web.HTTPForbidden(
+                text="profiling is loopback/token gated"
+            )
+        try:
+            seconds = float(
+                request.query.get("seconds", profile.DEFAULT_SECONDS)
+            )
+        except ValueError:
+            raise web.HTTPBadRequest(text="seconds must be a number")
+        result = await profile.CAPTURE.capture(seconds)
+        return web.json_response(result, dumps=_dumps_repr)
+
+    @routes.get("/debug/profile")
+    async def profile_status(request):
+        return web.json_response(profile.CAPTURE.status(),
+                                 dumps=_dumps_repr)
 
     @routes.get("/debug/traces")
     async def traces(request):
@@ -313,7 +359,8 @@ def build_rest_app(daemon) -> web.Application:
 
         return daemon_status(daemon)
 
-    _add_obs_routes(routes, _status)
+    _add_obs_routes(routes, _status,
+                    slo_fn=getattr(daemon, "slo_json", None))
 
     app = web.Application()
     app.add_routes(routes)

@@ -123,6 +123,7 @@ def build_public_server(daemon, address: str,
             prev_sig=request.previous_signature,
             partial_sig=request.partial_signature,
             trace_id=trace_id,
+            sent_at=request.sent_at,
         )
         try:
             await daemon.process_beacon_packet(packet)
@@ -501,6 +502,7 @@ class GrpcClient(ProtocolClient):
             previous_signature=packet.prev_sig,
             partial_signature=packet.partial_sig,
             trace_id=packet.trace_id,
+            sent_at=packet.sent_at,
         )
         # the trace id rides BOTH the proto field and gRPC metadata, so
         # middleboxes that only read headers can still stitch the round

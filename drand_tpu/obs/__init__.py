@@ -12,6 +12,13 @@ The triad any serving stack needs before it can be operated:
   dispatch with block-until-ready wall timings feeding the tracer, the
   `drand_device_kernel_seconds` histograms and the flight recorder.
 * `obs.introspect` — the `GET /v1/status` health document.
+* `obs.slo`     — SLO engine: error budgets and multi-window burn-rate
+  alerting over the round-finalize and gateway-verify latencies, served
+  at `GET /v1/slo`.
+* `obs.peers`   — per-signer contribution ledger: arrival latency,
+  missed/invalid partials, clock-skew estimates and suspect ranking.
+* `obs.profile` — single-flight on-demand device profiling behind
+  `POST /debug/profile`.
 
 Import cost is trivially small (stdlib only), so protocol modules import
 this unconditionally; sampling off (`DRAND_TPU_TRACE=off` or
@@ -20,6 +27,15 @@ this unconditionally; sampling off (`DRAND_TPU_TRACE=off` or
 
 from drand_tpu.obs.flight import RECORDER, FlightRecorder, install_crash_handler
 from drand_tpu.obs.kernels import block, kernel_span
+from drand_tpu.obs.peers import PeerLedger
+from drand_tpu.obs.profile import CAPTURE, ProfileCapture
+from drand_tpu.obs.slo import (
+    ENGINE,
+    ROUND_FINALIZE,
+    VERIFY_LATENCY,
+    Objective,
+    SLOEngine,
+)
 from drand_tpu.obs.trace import (
     NOOP_SPAN,
     TRACER,
@@ -31,12 +47,20 @@ from drand_tpu.obs.trace import (
 )
 
 __all__ = [
+    "CAPTURE",
+    "ENGINE",
     "FlightRecorder",
     "NOOP_SPAN",
+    "Objective",
+    "PeerLedger",
+    "ProfileCapture",
     "RECORDER",
+    "ROUND_FINALIZE",
+    "SLOEngine",
     "Span",
     "TRACER",
     "Tracer",
+    "VERIFY_LATENCY",
     "block",
     "derive_trace_id",
     "dkg_trace_id",

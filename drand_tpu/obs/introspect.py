@@ -12,7 +12,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from drand_tpu.obs import flight, trace
+from drand_tpu.obs import flight, kernels, trace
 
 
 def _chain_status(beacon, now: float) -> Optional[dict]:
@@ -38,10 +38,25 @@ def _chain_status(beacon, now: float) -> Optional[dict]:
 def _peer_status(beacon, now: float) -> dict:
     if beacon is None:
         return {}
-    return {
+    out = {
         addr: {"last_seen": ts, "seconds_ago": round(now - ts, 3)}
         for addr, ts in sorted(beacon.peer_seen.items())
     }
+    # merge the contribution ledger (latency/missed/invalid/skew/suspect
+    # scoring) when the handler carries one — liveness keys stay intact
+    ledger = getattr(beacon, "peer_ledger", None)
+    if ledger is not None:
+        for addr, doc in ledger.snapshot(now).items():
+            merged = out.setdefault(addr, {})
+            merged.update(doc)
+    return out
+
+
+def _suspects(beacon, now: float) -> list:
+    ledger = getattr(beacon, "peer_ledger", None)
+    if ledger is None:
+        return []
+    return ledger.suspects(now)
 
 
 def _dkg_status(dkg) -> dict:
@@ -73,7 +88,9 @@ def daemon_status(d) -> dict:
         "chain": _chain_status(beacon, now),
         "dkg": _dkg_status(getattr(d, "dkg", None)),
         "peers": _peer_status(beacon, now),
+        "suspects": _suspects(beacon, now),
         "serve": (gateway.stats() if gateway is not None else None),
+        "kernels": kernels.counters(),
         "trace": {
             "enabled": trace.TRACER.enabled,
             "traces": trace.TRACER.trace_count(),

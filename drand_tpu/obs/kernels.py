@@ -24,6 +24,7 @@ and degrades to identity otherwise (pure-Python backends).
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict
 
@@ -31,6 +32,13 @@ from drand_tpu.obs import flight, trace
 from drand_tpu.utils import metrics
 
 _hists: Dict[str, object] = {}
+
+# per-op dispatch statistics beyond the histogram: first/max dispatch
+# wall time distinguishes a cold XLA compile (first dispatch orders of
+# magnitude slower) from steady-state dispatch — the signal `cli doctor`
+# and GET /debug/profile use
+_stats_lock = threading.Lock()
+_stats: Dict[str, Dict[str, float]] = {}
 
 
 def _hist(op: str):
@@ -42,6 +50,40 @@ def _hist(op: str):
             labels={"op": op},
         )
     return h
+
+
+def _note_dispatch(op: str, dt: float) -> None:
+    with _stats_lock:
+        st = _stats.get(op)
+        if st is None:
+            st = _stats[op] = {
+                "dispatches": 0, "seconds_total": 0.0,
+                "first_seconds": dt, "max_seconds": dt,
+            }
+        st["dispatches"] += 1
+        st["seconds_total"] += dt
+        st["max_seconds"] = max(st["max_seconds"], dt)
+
+
+def counters() -> Dict[str, dict]:
+    """Per-op dispatch counters (count, total/first/max wall seconds)
+    for /v1/status and the profile endpoint — the compile/dispatch view
+    the kernel spans already carry, aggregated."""
+    with _stats_lock:
+        return {
+            op: {
+                "dispatches": int(st["dispatches"]),
+                "seconds_total": round(st["seconds_total"], 6),
+                "first_seconds": round(st["first_seconds"], 6),
+                "max_seconds": round(st["max_seconds"], 6),
+            }
+            for op, st in sorted(_stats.items())
+        }
+
+
+def reset_counters() -> None:
+    with _stats_lock:
+        _stats.clear()
 
 
 def block(x):
@@ -70,6 +112,7 @@ def kernel_span(op: str, **attrs):
     except BaseException as exc:
         dt = time.perf_counter() - t0
         _hist(op).observe(dt)
+        _note_dispatch(op, dt)
         flight.RECORDER.record("kernel", op=op, seconds=dt,
                                error=repr(exc), **attrs)
         span.__exit__(type(exc), exc, exc.__traceback__)
@@ -77,6 +120,7 @@ def kernel_span(op: str, **attrs):
     else:
         dt = time.perf_counter() - t0
         _hist(op).observe(dt)
+        _note_dispatch(op, dt)
         span.set_attr("seconds", dt)
         flight.RECORDER.record("kernel", op=op, seconds=dt, **attrs)
         span.__exit__(None, None, None)

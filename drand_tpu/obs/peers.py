@@ -1,0 +1,337 @@
+"""Per-signer contribution ledger: accountability for every group member.
+
+`beacon.peer_seen` (PR 2) answers "is the peer alive?"; operating a
+threshold network needs the sharper question "is the peer *pulling its
+weight*?".  The ledger watches every inbound partial and every completed
+round and keeps, per signer:
+
+* **arrival latency** relative to the round's open time (`time_of_round`)
+  — bucketed histogram plus running min/max/EWMA, so a peer that signs
+  late every round is visible even while rounds still finalize;
+* **missed contributions** — rounds this node finalized without a valid
+  partial from that signer (the threshold absorbed the absence, but the
+  margin shrank).  With t < n the slowest healthy signer loses this race
+  *every* round, so a partial that arrives after its round finalized
+  credits the miss back and counts as **late** instead — chronic
+  lateness still surfaces through the latency EWMA, but a healthy peer
+  no longer drifts into the suspect list just for finishing last;
+* **invalid partials** — partials that failed signature verification
+  (round-window rejects are counted in the rejected-packets metric but
+  not charged here: a stale packet is a timing symptom, not forgery);
+* **clock-skew estimate** — from the `sent_at` stamp beacon packets
+  carry: `recv - sent` is skew plus network delay, so the MINIMUM over
+  samples upper-bounds the skew tightly on any reasonable network, and
+  an EWMA tracks drift.
+
+`suspects()` ranks peers by a composite score so `/v1/status` (and
+`cli doctor`) can say not just "something is late" but "node X is the
+likely cause".  All timestamps come from the caller's clock, so a
+`FakeClock` test drives staleness and skew deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from drand_tpu.utils import metrics
+
+#: latency histogram bucket edges as fractions of the beacon period
+_LATENCY_FRACTIONS = (0.1, 0.25, 0.5, 0.75, 1.0, 2.0)
+
+#: EWMA smoothing for latency/skew trends
+_ALPHA = 0.2
+
+#: rounds of miss bookkeeping kept for late-arrival credit
+_RECENT_ROUNDS = 32
+
+#: suspect-score weights (unitless; tuned so one chronic signal ~ 1.0)
+_W_MISSED = 1.0
+_W_INVALID = 0.5
+_W_LATE = 1.0
+_W_STALE = 1.0
+_W_SKEW = 0.5
+
+
+class PeerStats:
+    """Mutable per-signer record (lock held by the owning ledger)."""
+
+    __slots__ = (
+        "address", "partials", "invalid", "missed", "late", "last_seen",
+        "last_round", "latency_buckets", "latency_last", "latency_ewma",
+        "latency_min", "latency_max", "skew_min", "skew_ewma",
+        "skew_samples",
+    )
+
+    def __init__(self, address: str):
+        self.address = address
+        self.partials = 0
+        self.invalid = 0
+        self.missed = 0
+        self.late = 0
+        self.last_seen: Optional[float] = None
+        self.last_round: Optional[int] = None
+        self.latency_buckets = [0] * (len(_LATENCY_FRACTIONS) + 1)
+        self.latency_last: Optional[float] = None
+        self.latency_ewma: Optional[float] = None
+        self.latency_min: Optional[float] = None
+        self.latency_max: Optional[float] = None
+        self.skew_min: Optional[float] = None
+        self.skew_ewma: Optional[float] = None
+        self.skew_samples = 0
+
+
+class PeerLedger:
+    """Contribution accounting for one group, fed by the beacon handler.
+
+    `addresses` is the full group membership; `self_address` is excluded
+    from missed-contribution accounting (our own partial is always
+    counted by construction).
+    """
+
+    def __init__(self, addresses: Iterable[str], self_address: str,
+                 period: float):
+        self.period = float(period)
+        self.self_address = self_address
+        self._lock = threading.Lock()
+        self._peers: Dict[str, PeerStats] = {
+            a: PeerStats(a) for a in addresses if a != self_address
+        }
+        self._bounds = tuple(f * self.period for f in _LATENCY_FRACTIONS)
+        # round -> signers whose valid partial arrived, kept for a few
+        # rounds: finalize snapshots its partial set at threshold, so a
+        # partial landing during the recovery math would otherwise be
+        # marked missed even though it arrived before round_complete
+        self._round_partials: Dict[int, set] = {}
+        # round -> signers marked missed at finalize, kept for a few
+        # rounds so a straggling partial can convert its miss to "late"
+        self._recent_missed: Dict[int, set] = {}
+
+    def _get(self, address: str) -> PeerStats:
+        st = self._peers.get(address)
+        if st is None:
+            # out-of-group sender (reshare transition, misconfig): track
+            # it anyway — an unknown signer flooding partials is exactly
+            # what an operator wants surfaced
+            st = self._peers[address] = PeerStats(address)
+        return st
+
+    # -- recording (handler hot path: O(1), one small lock) ---------------
+
+    def record_partial(self, address: str, round: int, *, ts: float,
+                       round_open: float,
+                       sent_at: Optional[float] = None) -> None:
+        """A VALID partial from `address` for `round` arrived at `ts`;
+        `round_open` is the round's scheduled start, `sent_at` the
+        sender's own clock stamp (0/None when not carried)."""
+        latency = max(0.0, ts - round_open)
+        with self._lock:
+            st = self._get(address)
+            st.partials += 1
+            contributed = self._round_partials.setdefault(round, set())
+            contributed.add(address)
+            while len(self._round_partials) > _RECENT_ROUNDS:
+                self._round_partials.pop(next(iter(self._round_partials)))
+            marked = self._recent_missed.get(round)
+            if marked is not None and address in marked:
+                # lost the race to the threshold, not absent: credit the
+                # miss back (the latency EWMA still records the lateness)
+                marked.discard(address)
+                st.missed -= 1
+                st.late += 1
+                _late_counter(address).inc()
+            st.last_seen = ts
+            st.last_round = (round if st.last_round is None
+                             else max(st.last_round, round))
+            for i, b in enumerate(self._bounds):
+                if latency <= b:
+                    st.latency_buckets[i] += 1
+                    break
+            else:
+                st.latency_buckets[-1] += 1
+            st.latency_last = latency
+            st.latency_ewma = (
+                latency if st.latency_ewma is None
+                else (1 - _ALPHA) * st.latency_ewma + _ALPHA * latency
+            )
+            st.latency_min = (latency if st.latency_min is None
+                              else min(st.latency_min, latency))
+            st.latency_max = (latency if st.latency_max is None
+                              else max(st.latency_max, latency))
+            if sent_at:
+                skew = ts - sent_at
+                st.skew_samples += 1
+                st.skew_min = (skew if st.skew_min is None
+                               else min(st.skew_min, skew))
+                st.skew_ewma = (
+                    skew if st.skew_ewma is None
+                    else (1 - _ALPHA) * st.skew_ewma + _ALPHA * skew
+                )
+        _latency_hist(address).observe(latency)
+
+    def record_invalid(self, address: str, ts: float) -> None:
+        """A partial from `address` failed signature verification."""
+        with self._lock:
+            st = self._get(address)
+            st.invalid += 1
+        _invalid_counter(address).inc()
+
+    def round_complete(self, round: int,
+                       contributors: Iterable[str]) -> None:
+        """A round finalized; every known signer NOT in `contributors`
+        missed it (the threshold margin absorbed their absence)."""
+        got = set(contributors)
+        with self._lock:
+            # union with partials the ledger saw directly: the finalize
+            # path snapshots its set at threshold, the ledger keeps
+            # counting arrivals through the recovery math
+            got |= self._round_partials.get(round, set())
+            marked = set()
+            for addr, st in self._peers.items():
+                if addr not in got:
+                    st.missed += 1
+                    marked.add(addr)
+                    _missed_counter(addr).inc()
+            self._recent_missed[round] = marked
+            while len(self._recent_missed) > _RECENT_ROUNDS:
+                self._recent_missed.pop(next(iter(self._recent_missed)))
+
+    # -- queries -----------------------------------------------------------
+
+    def _score(self, st: PeerStats,
+               now: float) -> Tuple[float, List[str]]:
+        """Composite suspicion score + human-readable reasons."""
+        score = 0.0
+        reasons: List[str] = []
+        seen = st.partials + st.missed
+        if seen:
+            miss_ratio = st.missed / seen
+            if miss_ratio > 0.0:
+                score += _W_MISSED * miss_ratio
+            if miss_ratio >= 0.25:
+                reasons.append(
+                    f"missed {st.missed}/{seen} rounds"
+                )
+        elif st.invalid == 0 and st.last_seen is None:
+            # never heard from at all: maximally suspect once the
+            # chain is moving
+            score += _W_MISSED
+            reasons.append("no valid partial ever received")
+        if st.invalid:
+            score += _W_INVALID * min(1.0, st.invalid / 10.0)
+            reasons.append(f"{st.invalid} invalid partials")
+        if st.latency_ewma is not None and self.period > 0:
+            late = st.latency_ewma / self.period
+            if late > 0.5:
+                score += _W_LATE * min(1.0, late)
+                reasons.append(
+                    f"partials arrive {st.latency_ewma:.2f}s after "
+                    f"round open ({late:.0%} of the period)"
+                )
+        if st.last_seen is not None and self.period > 0:
+            stale = (now - st.last_seen) / self.period
+            if stale > 2.0:
+                score += _W_STALE * min(1.0, stale / 10.0)
+                reasons.append(
+                    f"last valid partial {now - st.last_seen:.0f}s ago"
+                )
+        if st.skew_min is not None and self.period > 0:
+            skew = abs(st.skew_min) / self.period
+            if skew > 0.25:
+                score += _W_SKEW * min(1.0, skew)
+                reasons.append(
+                    f"clock skew ~{st.skew_min:+.2f}s"
+                )
+        return score, reasons
+
+    def snapshot(self, now: float) -> Dict[str, dict]:
+        """Per-peer document merged into /v1/status."""
+        out = {}
+        with self._lock:
+            peers = dict(self._peers)
+        for addr, st in sorted(peers.items()):
+            score, reasons = self._score(st, now)
+            out[addr] = {
+                "partials": st.partials,
+                "invalid": st.invalid,
+                "missed": st.missed,
+                "late": st.late,
+                "last_seen": st.last_seen,
+                "seconds_ago": (round(now - st.last_seen, 3)
+                                if st.last_seen is not None else None),
+                "last_round": st.last_round,
+                "latency": {
+                    "last": _r(st.latency_last),
+                    "ewma": _r(st.latency_ewma),
+                    "min": _r(st.latency_min),
+                    "max": _r(st.latency_max),
+                    "buckets": {
+                        **{f"le_{f}p": st.latency_buckets[i]
+                           for i, f in enumerate(_LATENCY_FRACTIONS)},
+                        "inf": st.latency_buckets[-1],
+                    },
+                },
+                "clock_skew": {
+                    "estimate": _r(st.skew_min),
+                    "ewma": _r(st.skew_ewma),
+                    "samples": st.skew_samples,
+                },
+                "suspect_score": round(score, 3),
+                "suspect_reasons": reasons,
+            }
+        return out
+
+    def suspects(self, now: float, min_score: float = 0.25) -> List[dict]:
+        """Peers ranked most-suspect first (score >= min_score)."""
+        ranked = []
+        with self._lock:
+            peers = dict(self._peers)
+        for addr, st in peers.items():
+            score, reasons = self._score(st, now)
+            if score >= min_score:
+                ranked.append({
+                    "peer": addr,
+                    "score": round(score, 3),
+                    "reasons": reasons,
+                })
+        ranked.sort(key=lambda d: -d["score"])
+        return ranked
+
+
+def _r(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 4)
+
+
+def _latency_hist(peer: str):
+    return metrics.histogram(
+        "drand_peer_partial_latency_seconds",
+        "arrival latency of valid partials relative to round open",
+        labels={"peer": peer},
+    )
+
+
+def _invalid_counter(peer: str):
+    return metrics.counter(
+        "drand_peer_invalid_partials_total",
+        "partials that failed signature verification",
+        labels={"peer": peer},
+    )
+
+
+def _missed_counter(peer: str):
+    return metrics.counter(
+        "drand_peer_missed_rounds_total",
+        "rounds finalized without this signer's partial",
+        labels={"peer": peer},
+    )
+
+
+def _late_counter(peer: str):
+    # counters are monotonic, so a credited miss stays in
+    # drand_peer_missed_rounds_total; genuine absences are the
+    # difference between the two series
+    return metrics.counter(
+        "drand_peer_late_partials_total",
+        "partials that arrived after their round had already finalized",
+        labels={"peer": peer},
+    )

@@ -1,0 +1,168 @@
+"""On-demand device profiling: `POST /debug/profile?seconds=N`.
+
+The static `DRAND_TPU_PROFILE_DIR` knob (utils/profiling.py) must be set
+before boot and traces the whole process lifetime — useless on a live
+node that started misbehaving an hour ago.  This module is the
+ML-serving answer: an operator asks a *running* daemon for an N-second
+XLA profiler capture and gets back the trace directory to pull into
+xprof/TensorBoard.
+
+Design constraints, in order:
+
+* **Single-flight.**  The JAX profiler is process-global; two
+  overlapping captures corrupt each other.  Concurrent requests
+  coalesce onto the one in-flight capture and all receive the same
+  result (the second caller marked `coalesced`), so under any burst the
+  device is traced exactly once.
+* **Bounded.**  `seconds` is clamped to `MAX_SECONDS`; a capture cannot
+  be left running by a disconnecting client because the timer, not the
+  request, ends it.
+* **Degrades, never breaks.**  On a host without a working jax profiler
+  the capture still produces a non-empty directory: a JSON fallback
+  carrying the kernel dispatch counters and the recent flight-recorder
+  events — less detail, same workflow.  Every capture additionally
+  writes a `capture.json` manifest (params, backend, kernel counters
+  observed during the window).
+
+Auth is the REST layer's concern (`net/rest.py` gates the route to
+loopback callers or an explicit `DRAND_TPU_PROFILE_TOKEN`); this module
+only enforces the single-flight and bounds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import tempfile
+import time
+from typing import List, Optional
+
+from drand_tpu.obs import flight, kernels
+from drand_tpu.utils import profiling
+from drand_tpu.utils.logging import get_logger
+
+log = get_logger("obs.profile")
+
+#: hard cap on one capture; profiling is not free and an operator typo
+#: ("seconds=3600") must not degrade the beacon for an hour
+MAX_SECONDS = 60.0
+DEFAULT_SECONDS = 2.0
+
+
+def _list_files(tdir: str) -> List[str]:
+    out = []
+    for root, _dirs, files in os.walk(tdir):
+        for f in files:
+            out.append(os.path.relpath(os.path.join(root, f), tdir))
+    return sorted(out)
+
+
+class ProfileCapture:
+    """Single-flight on-demand capture manager (one per process)."""
+
+    def __init__(self, base_dir: Optional[str] = None):
+        self.base_dir = base_dir
+        self._inflight: Optional[asyncio.Future] = None
+        self._last: Optional[dict] = None
+
+    @property
+    def running(self) -> bool:
+        return self._inflight is not None and not self._inflight.done()
+
+    async def capture(self, seconds: float = DEFAULT_SECONDS,
+                      base_dir: Optional[str] = None) -> dict:
+        """Capture a device trace for ~`seconds`; returns the result
+        document.  Concurrent calls coalesce onto the in-flight capture
+        (their result carries ``coalesced: true``)."""
+        if self.running:
+            res = dict(await asyncio.shield(self._inflight))
+            res["coalesced"] = True
+            return res
+        seconds = min(MAX_SECONDS, max(0.0, float(seconds)))
+        loop = asyncio.get_running_loop()
+        self._inflight = loop.create_future()
+        try:
+            res = await self._capture_once(seconds, base_dir)
+        except BaseException as exc:
+            if not self._inflight.done():
+                self._inflight.set_exception(exc)
+                # coalesced waiters saw it; nobody else will
+                self._inflight.exception()
+            raise
+        else:
+            if not self._inflight.done():
+                self._inflight.set_result(res)
+            self._last = res
+            return dict(res)
+
+    async def _capture_once(self, seconds: float,
+                            base_dir: Optional[str]) -> dict:
+        tdir = tempfile.mkdtemp(
+            prefix="drand-profile-",
+            dir=base_dir or self.base_dir or None,
+        )
+        started = time.time()
+        kernels_before = kernels.counters()
+        flight.RECORDER.record("profile_start", dir=tdir,
+                               seconds=seconds)
+        device_traced = profiling.start_device_trace(tdir)
+        try:
+            if seconds > 0:
+                await asyncio.sleep(seconds)
+        finally:
+            if device_traced:
+                # stop_trace serializes the xplane protobufs — blocking
+                # work that must not stall the event loop
+                try:
+                    await asyncio.to_thread(profiling.stop_device_trace)
+                except Exception as exc:
+                    log.warning("profiler stop failed", err=exc)
+                    device_traced = False
+        kernels_after = kernels.counters()
+        window = {
+            op: (st["dispatches"]
+                 - kernels_before.get(op, {}).get("dispatches", 0))
+            for op, st in kernels_after.items()
+        }
+        manifest = {
+            "dir": tdir,
+            "seconds": seconds,
+            "started_unix": started,
+            "device_traced": device_traced,
+            "kernel_dispatches_in_window": window,
+            "kernel_counters": kernels_after,
+        }
+        if not device_traced:
+            # fallback payload: the capture still says something useful
+            with open(os.path.join(tdir, "profile_fallback.json"),
+                      "w") as fh:
+                json.dump({
+                    "note": "jax profiler unavailable; kernel counters "
+                            "and flight events only",
+                    "kernel_counters": kernels_after,
+                    "flight_events": flight.RECORDER.snapshot()[-256:],
+                }, fh, default=repr)
+        with open(os.path.join(tdir, "capture.json"), "w") as fh:
+            json.dump(manifest, fh)
+        result = dict(manifest)
+        result["files"] = _list_files(tdir)
+        result["coalesced"] = False
+        flight.RECORDER.record("profile_done", dir=tdir,
+                               files=len(result["files"]),
+                               device_traced=device_traced)
+        return result
+
+    def status(self) -> dict:
+        """GET /debug/profile document: capture state + the live
+        compile/dispatch counters from the kernel spans."""
+        return {
+            "running": self.running,
+            "last": self._last,
+            "max_seconds": MAX_SECONDS,
+            "kernels": kernels.counters(),
+        }
+
+
+#: process-wide capture manager (profiler state is process-global too)
+CAPTURE = ProfileCapture()

@@ -1,0 +1,319 @@
+"""SLO engine: judgment on top of the PR 2 measurement plane.
+
+The tracer/metrics/flight triad records *what happened*; this module
+decides *whether the service is healthy*.  Each `Objective` states a
+latency bound and a target fraction ("99% of rounds finalize within 50%
+of the period"); the engine turns every observation into a good/bad
+event, accumulates them in coarse time buckets, and computes the two
+figures SRE-style alerting is built on (Google SRE workbook ch. 5):
+
+* **error-budget remaining** over a rolling budget window — the
+  fraction of the allowed bad events not yet spent;
+* **multi-window burn rates** — for each (long, short) window pair,
+  the observed bad fraction divided by the budget fraction (1-target).
+  A burn rate of 1.0 spends the budget exactly at the sustainable pace;
+  a breach fires only when BOTH windows of a pair exceed the pair's
+  factor, so a brief spike (short window only) or an old stain (long
+  window only) cannot page anyone.
+
+Breach transitions are recorded as `slo_breach` flight-recorder events
+and counted in `drand_slo_breaches_total`; live burn/budget figures are
+exported as `drand_slo_*` gauges and the whole document is served at
+`GET /v1/slo`.
+
+Time is injectable end to end: callers stamp events with their own
+clock (`ts=clock.now()`) and snapshots take an explicit `now`, so a
+`FakeClock` test can drive the engine across a breach boundary without
+a single wall-clock sleep.  Like the tracer, everything is stdlib-only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from drand_tpu.obs import flight
+from drand_tpu.utils import metrics
+
+#: default multi-window burn-rate alert pairs: (long, short, factor),
+#: the SRE-workbook page/ticket ladder scaled to a 24h budget window
+DEFAULT_BURN_WINDOWS: Tuple[Tuple[float, float, float], ...] = (
+    (3600.0, 300.0, 14.4),     # page: 1h + 5m both burning >= 14.4x
+    (6 * 3600.0, 1800.0, 6.0),  # ticket: 6h + 30m both burning >= 6x
+)
+
+DEFAULT_BUDGET_WINDOW = 24 * 3600.0
+DEFAULT_BUCKET_SECONDS = 60.0
+
+
+def _win_label(seconds: float) -> str:
+    if seconds % 3600 == 0:
+        return f"{int(seconds // 3600)}h"
+    if seconds % 60 == 0:
+        return f"{int(seconds // 60)}m"
+    return f"{int(seconds)}s"
+
+
+@dataclass
+class Objective:
+    """One service-level objective: `target` fraction of events must be
+    good, where good means `value <= threshold` (seconds for latency
+    objectives).  `describe` is free text for operators."""
+
+    name: str
+    target: float = 0.99
+    threshold: float = 1.0
+    describe: str = ""
+    budget_window: float = DEFAULT_BUDGET_WINDOW
+    burn_windows: Tuple[Tuple[float, float, float], ...] = (
+        DEFAULT_BURN_WINDOWS
+    )
+    bucket_seconds: float = DEFAULT_BUCKET_SECONDS
+    #: bucket index -> [good, bad] counts (pruned past budget_window)
+    _buckets: Dict[int, List[int]] = field(default_factory=dict)
+    #: pair label -> currently-breaching flag (edge detection)
+    _breaching: Dict[str, bool] = field(default_factory=dict)
+    breaches: int = 0
+    last_ts: float = 0.0
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, good: bool, ts: float) -> None:
+        idx = int(ts // self.bucket_seconds)
+        b = self._buckets.get(idx)
+        if b is None:
+            b = self._buckets[idx] = [0, 0]
+            self._prune(ts)
+        b[0 if good else 1] += 1
+        self.last_ts = max(self.last_ts, ts)
+
+    def _prune(self, now: float) -> None:
+        floor = int((now - self.budget_window) // self.bucket_seconds)
+        for idx in [i for i in self._buckets if i < floor]:
+            del self._buckets[idx]
+
+    # -- queries -----------------------------------------------------------
+
+    def _counts(self, now: float, window: float) -> Tuple[int, int]:
+        lo = int((now - window) // self.bucket_seconds)
+        good = bad = 0
+        # list(): gauge export reads outside the engine lock while the
+        # hot path appends — a snapshot must not trip on a resize
+        for idx, (g, b) in list(self._buckets.items()):
+            if idx > lo:
+                good += g
+                bad += b
+        return good, bad
+
+    def bad_fraction(self, now: float, window: float) -> float:
+        good, bad = self._counts(now, window)
+        total = good + bad
+        return (bad / total) if total else 0.0
+
+    def burn_rate(self, now: float, window: float) -> float:
+        """Observed bad fraction relative to the budget fraction: 1.0
+        spends the error budget exactly over the budget window."""
+        budget = 1.0 - self.target
+        if budget <= 0.0:
+            return float("inf") if self.bad_fraction(now, window) else 0.0
+        return self.bad_fraction(now, window) / budget
+
+    def budget_remaining(self, now: float) -> float:
+        """Fraction of the error budget left over the budget window
+        (1.0 = untouched, 0.0 = exhausted, negative = overspent)."""
+        good, bad = self._counts(now, self.budget_window)
+        total = good + bad
+        if total == 0:
+            return 1.0
+        allowed = (1.0 - self.target) * total
+        if allowed <= 0.0:
+            return 1.0 if bad == 0 else float("-inf")
+        return 1.0 - bad / allowed
+
+    def check_breaches(self, now: float) -> List[dict]:
+        """Evaluate every burn-window pair; returns newly-fired breaches
+        (edge-triggered: active pairs report once per transition)."""
+        fired = []
+        for long_w, short_w, factor in self.burn_windows:
+            label = f"{_win_label(long_w)}/{_win_label(short_w)}"
+            long_burn = self.burn_rate(now, long_w)
+            short_burn = self.burn_rate(now, short_w)
+            active = long_burn >= factor and short_burn >= factor
+            if active and not self._breaching.get(label):
+                self.breaches += 1
+                fired.append({
+                    "slo": self.name, "window": label, "factor": factor,
+                    "long_burn": round(long_burn, 3),
+                    "short_burn": round(short_burn, 3),
+                })
+            self._breaching[label] = active
+        return fired
+
+    def snapshot(self, now: float) -> dict:
+        good, bad = self._counts(now, self.budget_window)
+        burn = {}
+        alerts = []
+        for long_w, short_w, factor in self.burn_windows:
+            label = f"{_win_label(long_w)}/{_win_label(short_w)}"
+            lb = self.burn_rate(now, long_w)
+            sb = self.burn_rate(now, short_w)
+            burn[_win_label(long_w)] = round(lb, 4)
+            burn[_win_label(short_w)] = round(sb, 4)
+            if self._breaching.get(label):
+                alerts.append({"window": label, "factor": factor,
+                               "long_burn": round(lb, 4),
+                               "short_burn": round(sb, 4)})
+        return {
+            "target": self.target,
+            "threshold_seconds": self.threshold,
+            "description": self.describe,
+            "budget_window_seconds": self.budget_window,
+            "good": good,
+            "bad": bad,
+            "budget_remaining": round(self.budget_remaining(now), 4),
+            "burn_rates": burn,
+            "breaching": alerts,
+            "breaches_total": self.breaches,
+            "last_event_ts": self.last_ts or None,
+        }
+
+
+class SLOEngine:
+    """Registry of objectives + the shared recording/alerting path.
+
+    `objective()` is idempotent (first registration wins) so call sites
+    can declare their objective at import/boot without coordinating.
+    """
+
+    def __init__(self, now_fn=time.time):
+        self._now_fn = now_fn
+        self._lock = threading.Lock()
+        self._objectives: Dict[str, Objective] = {}
+
+    # -- registration ------------------------------------------------------
+
+    def objective(self, name: str, *, target: float = 0.99,
+                  threshold: float = 1.0, describe: str = "",
+                  budget_window: float = DEFAULT_BUDGET_WINDOW,
+                  burn_windows=DEFAULT_BURN_WINDOWS,
+                  bucket_seconds: float = DEFAULT_BUCKET_SECONDS
+                  ) -> Objective:
+        with self._lock:
+            obj = self._objectives.get(name)
+            if obj is None:
+                obj = self._objectives[name] = Objective(
+                    name=name, target=target, threshold=threshold,
+                    describe=describe, budget_window=budget_window,
+                    burn_windows=tuple(burn_windows),
+                    bucket_seconds=bucket_seconds,
+                )
+            return obj
+
+    def get(self, name: str) -> Optional[Objective]:
+        with self._lock:
+            return self._objectives.get(name)
+
+    # -- recording ---------------------------------------------------------
+
+    def observe(self, name: str, value: float,
+                ts: Optional[float] = None) -> bool:
+        """Record one latency observation against `name`; the event is
+        good iff value <= the objective's threshold.  Returns goodness.
+        Unknown objectives are dropped (a misconfigured caller must not
+        crash the hot path)."""
+        obj = self.get(name)
+        if obj is None:
+            return True
+        good = value <= obj.threshold
+        self._record(obj, good, ts)
+        return good
+
+    def record_good(self, name: str, ts: Optional[float] = None) -> None:
+        obj = self.get(name)
+        if obj is not None:
+            self._record(obj, True, ts)
+
+    def record_bad(self, name: str, ts: Optional[float] = None) -> None:
+        """An event that failed outright (abandoned round, shed request)
+        — always burns budget regardless of the latency threshold."""
+        obj = self.get(name)
+        if obj is not None:
+            self._record(obj, False, ts)
+
+    def _record(self, obj: Objective, good: bool,
+                ts: Optional[float]) -> None:
+        if ts is None:
+            ts = self._now_fn()
+        with self._lock:
+            obj.record(good, ts)
+            fired = obj.check_breaches(ts)
+        _events(obj.name, "good" if good else "bad").inc()
+        for breach in fired:
+            _breaches(obj.name).inc()
+            flight.RECORDER.record("slo_breach", **breach)
+        self._export(obj, ts)
+
+    # -- export ------------------------------------------------------------
+
+    def _export(self, obj: Objective, now: float) -> None:
+        """Refresh the Prometheus gauges for one objective."""
+        metrics.gauge(
+            "drand_slo_error_budget_remaining",
+            "fraction of the SLO error budget left (1 = untouched)",
+            labels={"slo": obj.name},
+        ).set(obj.budget_remaining(now))
+        seen = set()
+        for long_w, short_w, _ in obj.burn_windows:
+            for w in (long_w, short_w):
+                if w in seen:
+                    continue
+                seen.add(w)
+                metrics.gauge(
+                    "drand_slo_burn_rate",
+                    "error-budget burn rate over a rolling window "
+                    "(1 = sustainable pace)",
+                    labels={"slo": obj.name, "window": _win_label(w)},
+                ).set(obj.burn_rate(now, w))
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """The GET /v1/slo document."""
+        if now is None:
+            now = self._now_fn()
+        with self._lock:
+            objectives = dict(self._objectives)
+        doc = {}
+        for name, obj in sorted(objectives.items()):
+            with self._lock:
+                doc[name] = obj.snapshot(now)
+            self._export(obj, now)
+        return {"time": now, "objectives": doc}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._objectives.clear()
+
+
+def _events(slo: str, result: str):
+    return metrics.counter(
+        "drand_slo_events_total", "SLO events judged good or bad",
+        labels={"slo": slo, "result": result},
+    )
+
+
+def _breaches(slo: str):
+    return metrics.counter(
+        "drand_slo_breaches_total",
+        "multi-window burn-rate breach transitions",
+        labels={"slo": slo},
+    )
+
+
+#: process-wide engine (the beacon handler and gateway both feed it; the
+#: REST layer serves its snapshot at /v1/slo)
+ENGINE = SLOEngine()
+
+#: canonical objective names used across the codebase
+ROUND_FINALIZE = "round_finalize"
+VERIFY_LATENCY = "verify_latency"

@@ -44,6 +44,7 @@ from drand_tpu.beacon.chain import Beacon, beacon_message
 from drand_tpu.crypto import refimpl as ref
 from drand_tpu.crypto import tbls
 from drand_tpu.obs import flight as obs_flight
+from drand_tpu.obs import slo as obs_slo
 from drand_tpu.obs import trace as obs_trace
 from drand_tpu.serve.batcher import BatchItem, BatchScheduler
 from drand_tpu.serve.cache import VerifiedRoundCache
@@ -110,6 +111,13 @@ def _count_client_request(client: Optional[str]) -> None:
         "verification requests by client identity",
         labels={"client": name},
     ).inc()
+
+
+#: gateway SLO: fraction of verifies that must finish within the bound.
+#: 100ms covers a full batch tick + one kernel dispatch with margin; a
+#: shed/timeout/closed error burns budget regardless of latency.
+VERIFY_SLO_TARGET = 0.99
+VERIFY_SLO_THRESHOLD = 0.1
 
 
 def _consume_exception(fut: "asyncio.Future") -> None:
@@ -234,6 +242,14 @@ class VerifyGateway:
         # per-instance cache accounting for /v1/status hit rate
         self._hits = 0
         self._misses = 0
+        obs_slo.ENGINE.objective(
+            obs_slo.VERIFY_LATENCY,
+            target=VERIFY_SLO_TARGET,
+            threshold=VERIFY_SLO_THRESHOLD,
+            describe=f"{VERIFY_SLO_TARGET:.0%} of gateway verifies "
+                     f"answer within {VERIFY_SLO_THRESHOLD * 1000:.0f}ms "
+                     "(sheds and timeouts always burn budget)",
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -291,10 +307,21 @@ class VerifyGateway:
         attrs = {"round": req.round}
         if client:
             attrs["client"] = client
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
         with obs_trace.TRACER.span(
             "gateway.verify", trace_id=trace_id or None, attrs=attrs,
         ) as span:
-            return await self._verify_inner(req, timeout, span, client)
+            try:
+                res = await self._verify_inner(req, timeout, span, client)
+            except GatewayError:
+                # a request we refused or lost IS an SLO event: the
+                # caller asked and was not answered
+                obs_slo.ENGINE.record_bad(obs_slo.VERIFY_LATENCY)
+                raise
+            obs_slo.ENGINE.observe(obs_slo.VERIFY_LATENCY,
+                                   loop.time() - t0)
+            return res
 
     async def _verify_inner(self, req: VerifyRequest,
                             timeout: Optional[float],

@@ -54,3 +54,40 @@ def profile_span(name: str) -> Iterator[None]:
             stop = _active == 0
         if stop:
             jax.profiler.stop_trace()
+
+
+def start_device_trace(tdir: str) -> bool:
+    """Begin an on-demand device trace into `tdir` (obs/profile.py's
+    `POST /debug/profile`).  Shares the `_active` refcount with
+    `profile_span`, so an env-var span already holding the profiler
+    open makes this a joiner rather than a conflicting second trace.
+    Returns False when jax (or its profiler) is unavailable."""
+    global _active
+    try:
+        import jax
+    except Exception:
+        return False
+    with _lock:
+        start = _active == 0
+        _active += 1
+    if start:
+        try:
+            jax.profiler.start_trace(tdir)
+        except Exception:
+            with _lock:
+                _active -= 1
+            return False
+    return True
+
+
+def stop_device_trace() -> None:
+    """End an on-demand trace begun by `start_device_trace` (the actual
+    `stop_trace` fires only when the last holder releases)."""
+    global _active
+    import jax
+
+    with _lock:
+        _active -= 1
+        stop = _active == 0
+    if stop:
+        jax.profiler.stop_trace()

@@ -1,0 +1,121 @@
+"""`cli doctor`: the pure diagnosis function over captured documents,
+plus the two acceptance scenarios on a live 2-node in-process network —
+a lagging peer (rounds advance without it) and a stalled chain (the
+threshold is unreachable)."""
+
+from drand_tpu.cli import diagnose
+from drand_tpu.obs.introspect import daemon_status
+from drand_tpu.utils.clock import FakeClock
+
+from types import SimpleNamespace
+
+from test_beacon import PERIOD, build_network, wait_for_round
+
+
+def _status_of(handler, clock):
+    stub = SimpleNamespace(
+        pair=SimpleNamespace(public=handler.cfg.public),
+        clock=clock, scheme=handler.cfg.scheme, beacon=handler,
+        dkg=None, _verify_gateway=None,
+    )
+    return daemon_status(stub)
+
+
+# -- pure diagnosis over synthetic documents -----------------------------
+
+def test_diagnose_healthy():
+    status = {"chain": {"head_round": 5, "expected_round": 5,
+                        "running": True}, "suspects": []}
+    findings = diagnose(status, {"objectives": {}}, [])
+    assert [f["kind"] for f in findings] == ["healthy"]
+
+
+def test_diagnose_ranks_critical_first():
+    status = {
+        "chain": {"head_round": 2, "expected_round": 9, "running": True},
+        "suspects": [{"peer": "p1", "score": 1.5,
+                      "reasons": ["missed 7/9 rounds"]}],
+        "kernels": {"pairing_check": {"dispatches": 10,
+                                      "first_seconds": 42.0,
+                                      "seconds_total": 42.9}},
+    }
+    slo_doc = {"objectives": {"round_finalize": {
+        "budget_remaining": -2.0, "description": "d",
+        "breaching": [{"window": "1h/5m", "factor": 14.4,
+                       "long_burn": 30.0, "short_burn": 33.0}],
+    }}}
+    findings = diagnose(status, slo_doc, [])
+    kinds = [f["kind"] for f in findings]
+    assert "stalled_chain" in kinds
+    assert "lagging_peer" in kinds
+    assert "slo_burn" in kinds
+    assert "cold_compile" in kinds
+    sev = [f["severity"] for f in findings]
+    assert sev == sorted(sev, key={"critical": 0, "warning": 1,
+                                   "info": 2}.get)
+    assert findings[0]["severity"] == "critical"
+
+
+def test_diagnose_flags_low_budget_and_crash_events():
+    slo_doc = {"objectives": {"verify_latency": {
+        "budget_remaining": 0.1, "description": "", "breaching": [],
+    }}}
+    events = [{"kind": "kernel"}, {"kind": "signal", "signal": "SIGTERM"}]
+    findings = diagnose({}, slo_doc, events)
+    kinds = {f["kind"] for f in findings}
+    assert "slo_budget" in kinds
+    assert "recent_crash" in kinds
+
+
+# -- acceptance scenarios on a live 2-node network -----------------------
+
+async def test_doctor_flags_injected_lagging_peer():
+    """n=2 t=1: node 0 finalizes rounds alone while peer 1 is cut off —
+    the doctor must name the lagging peer."""
+    clock = FakeClock()
+    group, handlers, net, _ = build_network(2, 1, clock)
+    lagging = handlers[1].cfg.public.address
+    net.down.add(lagging)  # peer 1 is unreachable; its partials never land
+    try:
+        await handlers[0].start()
+        await clock.advance(10)  # genesis -> round 1
+        await wait_for_round(handlers[:1], 1)
+        await clock.advance(PERIOD)
+        await wait_for_round(handlers[:1], 2)
+        await clock.advance(PERIOD)
+        await wait_for_round(handlers[:1], 3)
+
+        status = _status_of(handlers[0], clock)
+        assert status["peers"][lagging]["missed"] >= 3
+        findings = diagnose(status, {"objectives": {}}, [])
+        lag = [f for f in findings if f["kind"] == "lagging_peer"]
+        assert lag, f"expected a lagging_peer finding, got {findings}"
+        assert lagging in lag[0]["summary"]
+        assert "missed" in lag[0]["detail"]
+    finally:
+        await handlers[0].stop()
+
+
+async def test_doctor_flags_stalled_chain():
+    """n=2 t=2 with the other signer down: the threshold is unreachable,
+    the head stays at genesis while the clock marches on — the doctor
+    must call the chain stalled."""
+    clock = FakeClock()
+    group, handlers, net, _ = build_network(2, 2, clock)
+    net.down.add(handlers[1].cfg.public.address)
+    try:
+        await handlers[0].start()
+        # several periods pass; no round can reach threshold 2 alone
+        await clock.advance(10 + 3 * PERIOD)
+
+        status = _status_of(handlers[0], clock)
+        chain = status["chain"]
+        assert chain["head_round"] == 0
+        assert chain["expected_round"] >= 3
+        findings = diagnose(status, {"objectives": {}}, [])
+        stalled = [f for f in findings if f["kind"] == "stalled_chain"]
+        assert stalled, f"expected stalled_chain, got {findings}"
+        assert stalled[0]["severity"] == "critical"
+        assert "stalled" in stalled[0]["summary"]
+    finally:
+        await handlers[0].stop()

@@ -1,0 +1,188 @@
+"""Per-signer contribution ledger + /v1/status peer staleness, all
+deterministic under FakeClock — no wall-clock sleeps."""
+
+from types import SimpleNamespace
+
+from drand_tpu.obs.peers import PeerLedger
+from drand_tpu.utils.clock import FakeClock
+
+from test_beacon import PERIOD, build_network
+
+A, B, ME = "10.0.0.1:1", "10.0.0.2:2", "10.0.0.9:9"
+
+
+def _ledger() -> PeerLedger:
+    return PeerLedger([A, B, ME], self_address=ME, period=30.0)
+
+
+def test_latency_accounting_and_buckets():
+    led = _ledger()
+    t0 = 1000.0
+    # A signs promptly (2s after open), B late (20s = 2/3 period)
+    for rnd in range(1, 6):
+        open_t = t0 + rnd * 30.0
+        led.record_partial(A, rnd, ts=open_t + 2.0, round_open=open_t)
+        led.record_partial(B, rnd, ts=open_t + 20.0, round_open=open_t)
+        led.round_complete(rnd, [A, B])
+    snap = led.snapshot(now=t0 + 6 * 30.0)
+    assert snap[A]["partials"] == 5 and snap[A]["missed"] == 0
+    assert snap[A]["latency"]["ewma"] == 2.0
+    assert snap[A]["latency"]["min"] == 2.0
+    assert snap[A]["latency"]["max"] == 2.0
+    # 2s / 30s period lands in the <=0.1-period bucket
+    assert snap[A]["latency"]["buckets"]["le_0.1p"] == 5
+    # 20s / 30s lands in the <=0.75-period bucket
+    assert snap[B]["latency"]["buckets"]["le_0.75p"] == 5
+    assert snap[A]["suspect_score"] < 0.25
+    # B's chronic lateness (> half the period) makes it suspect
+    assert snap[B]["suspect_score"] >= 0.25
+    assert any("arrive" in r for r in snap[B]["suspect_reasons"])
+    suspects = led.suspects(now=t0 + 6 * 30.0)
+    assert [s["peer"] for s in suspects] == [B]
+
+
+def test_missed_rounds_and_invalid_partials_rank_suspects():
+    led = _ledger()
+    for rnd in range(1, 11):
+        open_t = 1000.0 + rnd * 30.0
+        led.record_partial(A, rnd, ts=open_t + 1.0, round_open=open_t)
+        # B contributes only every 5th round
+        if rnd % 5 == 0:
+            led.record_partial(B, rnd, ts=open_t + 1.0, round_open=open_t)
+            led.round_complete(rnd, [A, B])
+        else:
+            led.round_complete(rnd, [A])
+    led.record_invalid(B, 1400.0)
+    snap = led.snapshot(now=1400.0)
+    assert snap[B]["missed"] == 8 and snap[B]["partials"] == 2
+    assert snap[B]["invalid"] == 1
+    assert snap[A]["missed"] == 0
+    suspects = led.suspects(now=1400.0)
+    assert suspects and suspects[0]["peer"] == B
+    assert any("missed 8/10" in r for r in suspects[0]["reasons"])
+    # self never appears: its partial is counted by construction
+    assert ME not in snap
+
+
+def test_late_partial_credits_the_miss():
+    led = _ledger()
+    # with t < n the slowest healthy signer loses the finalize race
+    # every round: marked missed at round_complete, then its partial
+    # lands moments later and converts the miss to "late"
+    for rnd in range(1, 6):
+        open_t = 1000.0 + rnd * 30.0
+        led.record_partial(A, rnd, ts=open_t + 1.0, round_open=open_t)
+        led.round_complete(rnd, [A])
+        led.record_partial(B, rnd, ts=open_t + 2.0, round_open=open_t)
+    snap = led.snapshot(now=1000.0 + 6 * 30.0)
+    assert snap[B]["missed"] == 0 and snap[B]["late"] == 5
+    assert snap[B]["partials"] == 5
+    assert snap[B]["suspect_score"] < 0.25
+    assert led.suspects(now=1000.0 + 6 * 30.0) == []
+    # a partial for a round never marked missed doesn't go negative
+    led.record_partial(B, 5, ts=1160.0, round_open=1150.0)
+    assert led.snapshot(now=1200.0)[B]["missed"] == 0
+    # the credit window is bounded: a miss older than _RECENT_ROUNDS
+    # completed rounds stays a miss
+    for rnd in range(10, 50):
+        led.round_complete(rnd, [A, B])
+    led.round_complete(50, [A])           # B missed round 50
+    for rnd in range(51, 85):
+        led.round_complete(rnd, [A, B])   # 34 rounds push 50 out
+    led.record_partial(B, 50, ts=3000.0, round_open=2500.0)
+    assert led.snapshot(now=3000.0)[B]["missed"] == 1
+
+
+def test_partial_during_finalize_is_not_missed():
+    # finalize snapshots its partial set at threshold; a partial that
+    # lands while the recovery math runs reaches the ledger BEFORE
+    # round_complete and must not be marked missed at all
+    led = _ledger()
+    open_t = 1030.0
+    led.record_partial(A, 1, ts=open_t + 0.5, round_open=open_t)
+    led.record_partial(B, 1, ts=open_t + 0.9, round_open=open_t)
+    led.round_complete(1, [A])  # threshold snapshot missed B's arrival
+    snap = led.snapshot(now=open_t + 5.0)
+    assert snap[B]["missed"] == 0 and snap[B]["late"] == 0
+    assert snap[B]["partials"] == 1
+
+
+def test_clock_skew_estimate_is_min_over_samples():
+    led = _ledger()
+    open_t = 1000.0
+    # A's clock runs 5s ahead; network delay varies 0.1..2s, so the
+    # observed (recv - sent) samples are skew(-5) + delay — the MINIMUM
+    # tightly upper-bounds the true skew
+    for i, delay in enumerate((2.0, 0.5, 0.1, 1.0)):
+        recv = open_t + 10.0 + i
+        led.record_partial(A, 1 + i, ts=recv, round_open=open_t,
+                           sent_at=recv + 5.0 - delay)
+        led.round_complete(1 + i, [A, B])
+    snap = led.snapshot(now=open_t + 60.0)
+    skew = snap[A]["clock_skew"]
+    assert skew["samples"] == 4
+    assert skew["estimate"] == -4.9  # min sample: -5 + 0.1
+    assert skew["ewma"] is not None
+
+
+def test_unknown_sender_is_tracked():
+    led = _ledger()
+    led.record_partial("203.0.113.7:666", 3, ts=1010.0, round_open=1000.0)
+    snap = led.snapshot(now=1020.0)
+    assert "203.0.113.7:666" in snap
+    assert snap["203.0.113.7:666"]["partials"] == 1
+
+
+async def test_status_peer_staleness_under_fake_clock():
+    """/v1/status merges liveness (peer_seen) with the contribution
+    ledger; staleness figures advance with the FakeClock only."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from drand_tpu.net.rest import build_rest_app
+    from drand_tpu.obs.introspect import daemon_status
+
+    clock = FakeClock()
+    group, handlers, net, _ = build_network(3, 2, clock)
+    h0 = handlers[0]
+    a1 = handlers[1].cfg.public.address
+    a2 = handlers[2].cfg.public.address
+
+    # inject contributions directly (no rounds run): peer 1 contributes
+    # now; peer 2 contributed one period ago and missed the last round
+    t_now = clock.now()
+    h0.peer_seen[a1] = t_now
+    h0.peer_seen[a2] = t_now - PERIOD
+    h0.peer_ledger.record_partial(a1, 2, ts=t_now,
+                                  round_open=t_now - 1.0)
+    h0.peer_ledger.record_partial(a2, 1, ts=t_now - PERIOD,
+                                  round_open=t_now - PERIOD - 1.0)
+    h0.peer_ledger.round_complete(2, [a1])
+
+    stub = SimpleNamespace(
+        pair=SimpleNamespace(public=h0.cfg.public),
+        clock=clock, scheme=h0.cfg.scheme, beacon=h0,
+        dkg=None, _verify_gateway=None,
+    )
+    stub.status_json = lambda: daemon_status(stub)
+    client = TestClient(TestServer(build_rest_app(stub)))
+    await client.start_server()
+    try:
+        st = await (await client.get("/v1/status")).json()
+        assert st["peers"][a1]["seconds_ago"] == 0.0
+        assert st["peers"][a2]["seconds_ago"] == PERIOD
+        assert st["peers"][a1]["partials"] == 1
+        assert st["peers"][a2]["missed"] == 1
+        assert st["peers"][a1]["latency"]["last"] == 1.0
+
+        # advance ONLY the fake clock: staleness moves in lockstep
+        await clock.advance(4 * PERIOD)
+        st = await (await client.get("/v1/status")).json()
+        assert st["peers"][a1]["seconds_ago"] == 4 * PERIOD
+        assert st["peers"][a2]["seconds_ago"] == 5 * PERIOD
+        # 5 periods silent -> stale enough to rank as suspect
+        assert any(s["peer"] == a2 for s in st["suspects"])
+        assert any("last valid partial" in r
+                   for s in st["suspects"] if s["peer"] == a2
+                   for r in s["reasons"])
+    finally:
+        await client.close()

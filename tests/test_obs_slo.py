@@ -1,0 +1,128 @@
+"""SLO engine: budget math, multi-window burn-rate breaches, and the
+`GET /v1/slo` document — all driven across a breach boundary with a
+FakeClock (no wall-clock sleeps anywhere)."""
+
+from types import SimpleNamespace
+
+from drand_tpu.obs import flight
+from drand_tpu.obs.slo import SLOEngine
+from drand_tpu.utils.clock import FakeClock
+
+
+def test_budget_and_burn_math():
+    eng = SLOEngine(now_fn=lambda: 0.0)
+    eng.objective("lat", target=0.9, threshold=1.0)
+    # 90 good + 10 bad over the budget window: exactly at target,
+    # budget fully spent but not overspent
+    for i in range(90):
+        eng.observe("lat", 0.5, ts=float(i * 60))
+    for i in range(90, 100):
+        eng.observe("lat", 5.0, ts=float(i * 60))
+    snap = eng.snapshot(now=100 * 60.0)["objectives"]["lat"]
+    assert snap["good"] == 90 and snap["bad"] == 10
+    assert abs(snap["budget_remaining"]) < 1e-9
+    # all-good stream: budget untouched, burn zero
+    eng2 = SLOEngine(now_fn=lambda: 0.0)
+    eng2.objective("ok", target=0.99, threshold=1.0)
+    for i in range(50):
+        eng2.record_good("ok", ts=float(i))
+    s2 = eng2.snapshot(now=50.0)["objectives"]["ok"]
+    assert s2["budget_remaining"] == 1.0
+    assert all(v == 0.0 for v in s2["burn_rates"].values())
+
+
+def test_breach_fires_once_per_transition_and_records_flight_event():
+    flight.RECORDER.clear()
+    clock = FakeClock()
+    eng = SLOEngine(now_fn=clock.now)
+    eng.objective("r", target=0.99, threshold=1.0)
+    t0 = clock.now()
+    # healthy history, then a hard failure burst: every window sees a
+    # bad fraction far above 1% -> burn >> 14.4 on both page windows
+    for i in range(20):
+        eng.observe("r", 0.1, ts=t0 + i)
+    obj = eng.get("r")
+    assert obj.breaches == 0
+    for i in range(30):
+        eng.record_bad("r", ts=t0 + 30 + i)
+    assert obj.breaches >= 1
+    first = obj.breaches
+    # staying in breach must not re-fire (edge-triggered)
+    eng.record_bad("r", ts=t0 + 120)
+    assert obj.breaches == first
+    kinds = [e for e in flight.RECORDER.snapshot()
+             if e["kind"] == "slo_breach"]
+    assert kinds and kinds[0]["slo"] == "r"
+    snap = eng.snapshot(now=t0 + 121)["objectives"]["r"]
+    assert snap["breaching"], "snapshot must show the active alert"
+    assert snap["budget_remaining"] < 0  # overspent
+    flight.RECORDER.clear()
+
+
+def test_unknown_objective_is_dropped_not_raised():
+    eng = SLOEngine(now_fn=lambda: 0.0)
+    assert eng.observe("nope", 1.0) is True
+    eng.record_bad("nope")  # must not raise
+    assert eng.snapshot(now=0.0)["objectives"] == {}
+
+
+def test_events_outside_window_age_out():
+    eng = SLOEngine(now_fn=lambda: 0.0)
+    eng.objective("w", target=0.9, threshold=1.0, budget_window=3600.0)
+    for i in range(10):
+        eng.record_bad("w", ts=float(i))
+    # a day later the bad events have aged past the budget window
+    snap = eng.snapshot(now=86400.0)["objectives"]["w"]
+    assert snap["good"] == 0 and snap["bad"] == 0
+    assert snap["budget_remaining"] == 1.0
+
+
+async def test_slo_endpoint_across_breach_boundary():
+    """Drive the engine across a breach boundary with a FakeClock and
+    read it back through GET /v1/slo on the daemon REST app."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from drand_tpu.net.rest import build_rest_app
+
+    clock = FakeClock()
+    eng = SLOEngine(now_fn=clock.now)
+    eng.objective("round_finalize", target=0.99, threshold=15.0,
+                  describe="99% of rounds finalize within half the period")
+    stub = SimpleNamespace(
+        clock=clock,
+        beacon=None,
+        home_status=lambda: "test",
+        status_json=lambda: {"state": "test"},
+        slo_json=lambda: eng.snapshot(now=clock.now()),
+    )
+    client = TestClient(TestServer(build_rest_app(stub)))
+    await client.start_server()
+    try:
+        # phase 1: healthy rounds, one per fake-clock period
+        for _ in range(20):
+            eng.observe("round_finalize", 2.0, ts=clock.now())
+            await clock.advance(30.0)
+        resp = await client.get("/v1/slo")
+        assert resp.status == 200
+        doc = await resp.json()
+        obj = doc["objectives"]["round_finalize"]
+        assert obj["good"] == 20 and obj["bad"] == 0
+        assert obj["budget_remaining"] == 1.0
+        assert obj["breaching"] == []
+        assert set(obj["burn_rates"]) == {"1h", "5m", "6h", "30m"}
+
+        # phase 2: cross the boundary — rounds blow the threshold
+        for _ in range(25):
+            eng.observe("round_finalize", 40.0, ts=clock.now())
+            await clock.advance(30.0)
+        resp = await client.get("/v1/slo")
+        doc = await resp.json()
+        obj = doc["objectives"]["round_finalize"]
+        assert obj["bad"] == 25
+        assert obj["budget_remaining"] < 0
+        assert obj["burn_rates"]["5m"] > 14.4
+        assert obj["breaching"], "both page windows must be burning"
+        assert obj["breaches_total"] >= 1
+        assert doc["time"] == clock.now()
+    finally:
+        await client.close()

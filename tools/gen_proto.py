@@ -72,7 +72,8 @@ def build_file() -> dp.FileDescriptorProto:
                  field("previous_round", 3, U64),
                  field("previous_signature", 4, BYT),
                  field("partial_signature", 5, BYT),
-                 field("trace_id", 6, STR)))
+                 field("trace_id", 6, STR),
+                 field("sent_at", 7, DBL)))
     m.append(msg("Empty"))
     m.append(msg("SyncRequest", field("from_round", 1, U64)))
     m.append(msg("BeaconRecord",
