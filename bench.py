@@ -34,6 +34,10 @@ Environment knobs:
                   partials->sig path) even on CPU; "0" disables it
                   (default: runs on accelerators only)
   BENCH_FINALIZE_ITERS  timed finalizes in the sub-bench (default 20)
+  BENCH_INGEST  "0" disables the partial_ingest sub-bench (eager
+                per-partial pairing verify vs the optimistic structural
+                admit — host-side native crypto, runs everywhere)
+  BENCH_INGEST_ITERS  timed admissions per mode (default 200)
   BENCH_PROFILE_DIR  write a JAX profiler trace of the timed iterations
                      here (inspect with xprof/tensorboard) — the
                      per-kernel breakdown VERDICT r3 asked for
@@ -159,6 +163,55 @@ def _pcts(values) -> dict:
     }
 
 
+def _bench_partial_ingest() -> dict:
+    """Arrival-time admission cost, the optimistic-finalization delta:
+    eager mode pays one pairing per inbound partial; optimistic mode
+    pays a structural check (length + subgroup + identity, no pairing).
+    Host-side native crypto, so this row is honest on any backend;
+    disable with BENCH_INGEST=0."""
+    if os.environ.get("BENCH_INGEST", "1") == "0":
+        return {"skipped": "BENCH_INGEST=0"}
+
+    from drand_tpu.crypto import tbls
+    from drand_tpu.crypto.poly import PriPoly
+
+    scheme = tbls._native_scheme_or_ref()
+    if not isinstance(scheme, tbls.NativeScheme):
+        return {"skipped": "native BLS backend unavailable"}
+    t, n = 3, 5
+    iters = int(os.environ.get("BENCH_INGEST_ITERS", "200"))
+    poly = PriPoly.random(t)
+    pub = poly.commit()
+    msg = b"drand-tpu bench ingest round"
+    partials = [scheme.partial_sign(s, msg) for s in poly.shares(n)]
+    # warm the per-signer pk cache: eager timing should be the
+    # steady-state round, not the first-contact MSM
+    for p in partials:
+        scheme.verify_partial(pub, msg, p)
+
+    def _time(fn):
+        laps = []
+        for i in range(iters):
+            p = partials[i % n]
+            t0 = time.perf_counter()
+            fn(p)
+            laps.append(time.perf_counter() - t0)
+        return laps
+
+    eager = _time(lambda p: scheme.verify_partial(pub, msg, p))
+    lazy = _time(scheme.check_partial_structure)
+    e50 = max(float(np.percentile(np.asarray(eager), 50)), 1e-12)
+    l50 = max(float(np.percentile(np.asarray(lazy), 50)), 1e-12)
+    return {
+        "iters": iters,
+        "eager_seconds_percentiles": _pcts(eager),
+        "lazy_seconds_percentiles": _pcts(lazy),
+        "eager_partials_per_sec": round(1.0 / e50, 1),
+        "lazy_partials_per_sec": round(1.0 / l50, 1),
+        "speedup_p50": round(e50 / l50, 1),
+    }
+
+
 def _bench_round_finalize() -> dict:
     """Time the fused round-finalize path (partials -> verified
     collective sig) end to end on JaxScheme, and count device dispatches
@@ -215,6 +268,26 @@ def _bench_round_finalize() -> dict:
                                      []).append(s["duration"])
             kernel_pcts = {op: _pcts(ds)
                            for op, ds in sorted(by_op.items())}
+    # the optimistic variant: same quorum, ONE fused dispatch (no
+    # per-partial check rows) — the round loop's default finalize path
+    opt_laps = []
+    with obs_trace.TRACER.span("bench.finalize_optimistic") as sp_opt:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            t_lap = time.perf_counter()
+            opt_sig = scheme.finalize_round_optimistic(
+                pub, msg, partials, t, n
+            )
+            opt_laps.append(time.perf_counter() - t_lap)
+        opt_dt = time.perf_counter() - t0
+    assert opt_sig == sig, "optimistic finalize diverged from eager"
+    opt_dispatches = None
+    if sp_opt.trace_id is not None:
+        tr = obs_trace.TRACER.get_trace(sp_opt.trace_id)
+        if tr:
+            opt_kernels = [s for s in tr["spans"]
+                           if s["name"].startswith("kernel.")]
+            opt_dispatches = round(len(opt_kernels) / iters, 2)
     return {
         "t": t, "n": n, "iters": iters,
         "finalizes_per_sec": round(iters / dt, 1),
@@ -222,6 +295,12 @@ def _bench_round_finalize() -> dict:
         "finalize_seconds_percentiles": _pcts(lap_times),
         "device_dispatches_per_finalize": dispatches,
         "kernel_seconds_percentiles": kernel_pcts,
+        "optimistic": {
+            "finalizes_per_sec": round(iters / opt_dt, 1),
+            "seconds_per_finalize": round(opt_dt / iters, 5),
+            "finalize_seconds_percentiles": _pcts(opt_laps),
+            "device_dispatches_per_finalize": opt_dispatches,
+        },
     }
 
 
@@ -313,6 +392,12 @@ def main() -> None:
         finalize_detail = {
             "error": "%s: %s" % (type(e).__name__, str(e)[:200])
         }
+    try:
+        ingest_detail = _bench_partial_ingest()
+    except Exception as e:  # noqa: BLE001 — the headline row still ships
+        ingest_detail = {
+            "error": "%s: %s" % (type(e).__name__, str(e)[:200])
+        }
 
     per_rep = sorted(batch * iters / dt for dt in times)
     rounds_per_sec = float(np.median(per_rep))
@@ -352,6 +437,7 @@ def main() -> None:
             "cpu_fallback": os.environ.get("BENCH_FALLBACK") == "1",
             "est_1M_rounds_seconds": round(1_000_000 / rounds_per_sec, 1),
             "round_finalize": finalize_detail,
+            "partial_ingest": ingest_detail,
         },
     }))
 

@@ -67,6 +67,11 @@ _sync_verified = metrics.counter(
     "drand_beacon_sync_rounds_verified_total",
     "historical rounds batch-verified during catch-up sync",
 )
+_optimistic_fallbacks = metrics.counter(
+    "drand_beacon_optimistic_fallbacks_total",
+    "optimistic finalizes that failed the recovered-signature check and "
+    "fell back to the batched blame pass",
+)
 _round_seconds = metrics.histogram(
     "drand_beacon_round_seconds",
     "wall time from round start to stored beacon",
@@ -77,6 +82,18 @@ _head_gauge = metrics.gauge(
 
 #: how many sync'd beacons to verify per device batch
 SYNC_BATCH = 64
+
+#: gossip fan-out bound: sends launch healthy-peers-first and at most
+#: this many fly at once, so the priority order controls who hears us
+#: first even on large groups
+GOSSIP_CONCURRENCY = 8
+
+#: pause before the single gossip retry (transient-failure absorption)
+GOSSIP_RETRY_DELAY = 0.1
+
+#: optimistic finalize: bounded blame/evict/retry rounds before the
+#: quorum is declared unrecoverable and the attempt abandoned
+FINALIZE_ATTEMPTS = 8
 
 
 @dataclass
@@ -143,6 +160,12 @@ class BeaconConfig:
     #: beacons verified per device batch during catch-up; the pipelined
     #: sync prefetches the next batch while this one is on device
     sync_batch: int = SYNC_BATCH
+    #: "optimistic" (default): inbound partials are admitted with cheap
+    #: structural checks only and the quorum is verified via ONE
+    #: recovered-signature check, falling back to the batched blame pass
+    #: when it fails; "eager": every inbound partial pays a pairing
+    #: check at arrival time (the pre-optimization behavior)
+    partial_verify: str = "optimistic"
 
 
 class BeaconHandler:
@@ -159,6 +182,13 @@ class BeaconHandler:
             raise ValueError("this node is not part of the group")
         self.index = idx
         self.log = log.bind(node=idx, addr=cfg.public.address)
+        if cfg.partial_verify not in ("eager", "optimistic"):
+            raise ValueError(
+                "partial_verify must be 'eager' or 'optimistic', "
+                f"got {cfg.partial_verify!r}"
+            )
+        self._optimistic = cfg.partial_verify == "optimistic"
+        self._gossip_sem = asyncio.Semaphore(GOSSIP_CONCURRENCY)
         self.pub_poly = cfg.share.pub_poly()
         self.dist_key = cfg.share.public().key()
         self.manager = RoundManager(self.scheme.index_of)
@@ -321,7 +351,8 @@ class BeaconHandler:
                 self.scheme.partial_sign, self.cfg.share.share, msg
             )
         queue = self.manager.new_round(round, prev_round, prev_sig)
-        self.manager.add_partial(round, own, prev_round, prev_sig)
+        self.manager.add_partial(round, own, prev_round, prev_sig,
+                                 sender=self.cfg.public.address)
         packet = BeaconPacket(
             from_address=self.cfg.public.address,
             round=round,
@@ -335,9 +366,17 @@ class BeaconHandler:
             "beacon.gossip",
             attrs={"round": round, "peers": len(self.group) - 1},
         ):
-            for node in self.group.nodes:
-                if node.address == self.cfg.public.address:
-                    continue
+            peers = [n for n in self.group.nodes
+                     if n.address != self.cfg.public.address]
+            # healthy peers first: the quorum should assemble from
+            # responsive signers before any bandwidth goes to peers the
+            # contribution ledger already suspects — sends launch in
+            # this order and _send_packet's semaphore bounds how many
+            # fly at once, so the ordering actually bites
+            rank = {s["peer"]: s["score"]
+                    for s in self.peer_ledger.suspects(self.clock.now())}
+            peers.sort(key=lambda n: rank.get(n.address, 0.0))
+            for node in peers:
                 asyncio.create_task(self._send_packet(node, packet))
 
         with obs_trace.TRACER.span(
@@ -352,21 +391,25 @@ class BeaconHandler:
                 partials[self.scheme.index_of(blob)] = blob
             agg_span.set_attr("partials", len(partials))
 
-        # fused finalize: verify the partials, Lagrange-recover the
-        # group signature and re-check it against the distributed key in
-        # ONE scheme call (JaxScheme: <= 2 device dispatches; other
-        # backends compose recover + verify_recovered).  Off-loop like
-        # sign — the pairing math must not starve inbound partials.
-        with obs_trace.TRACER.span(
-            "beacon.verify",
-            attrs={"round": round, "partials": len(partials),
-                   "fused": True},
-        ):
-            sig = await asyncio.to_thread(
-                self.scheme.finalize_round,
-                self.pub_poly, msg, list(partials.values()),
-                self.group.threshold, len(self.group),
-            )
+        # finalize: recover the group signature and check it against the
+        # distributed key (optimistic: ONE fused dispatch over the first
+        # t admitted partials, blame fallback on a red check; eager: the
+        # fused per-partial verify + recover).  Off-loop like sign — the
+        # pairing math must not starve inbound partials.
+        try:
+            sig = await self._finalize_quorum(round, msg, partials, queue)
+        except tbls.ThresholdError as exc:
+            # unrecoverable partial set (all-bad quorum, attempts
+            # exhausted, or a red check no partial explains): abandon
+            # THIS attempt gracefully — the loop's next tick retargets
+            # the round fresh instead of the exception tearing through
+            # the traced span as a crash
+            _rounds_failed.inc()
+            obs_slo.ENGINE.record_bad(obs_slo.ROUND_FINALIZE,
+                                      ts=self.clock.now())
+            self.log.error("round unrecoverable, abandoning attempt",
+                           round=round, err=str(exc))
+            return
         beacon = Beacon(round=round, prev_round=prev_round,
                         prev_sig=prev_sig, signature=sig)
         # the head may have advanced while we were collecting — a benign
@@ -402,6 +445,88 @@ class BeaconHandler:
             self._running = False
             self._stopped.set()
 
+    async def _finalize_quorum(self, round: int, msg: bytes,
+                               partials: Dict[int, bytes],
+                               queue: asyncio.Queue) -> bytes:
+        """Turn the collected quorum into the round's group signature.
+
+        Eager mode is the single fused `finalize_round` call.  Optimistic
+        mode verifies ONLY the recovered signature (one device dispatch
+        on JaxScheme); when that check comes back red, one fused batched
+        pairing pass identifies the forged partials, each is charged to
+        the peer that SENT it (`record_invalid` on the sender address —
+        the claimed signer index proves nothing and must not frame its
+        honest owner), evicted from the round pool, and the quorum is
+        refilled from the queue before the next bounded attempt.
+        Raises ThresholdError when no clean quorum is recoverable.
+        """
+        t = self.group.threshold
+        if not self._optimistic:
+            with obs_trace.TRACER.span(
+                "beacon.verify",
+                attrs={"round": round, "partials": len(partials),
+                       "fused": True},
+            ):
+                return await asyncio.to_thread(
+                    self.scheme.finalize_round,
+                    self.pub_poly, msg, list(partials.values()),
+                    t, len(self.group),
+                )
+        for attempt in range(FINALIZE_ATTEMPTS):
+            # refill after evictions; the manager's standby buffer may
+            # already hold another sender's copy of an evicted index.
+            # If the network has nothing more to offer, this waits until
+            # the ticker cancels the attempt (ticker is king, as ever).
+            while len(partials) < t:
+                blob, _, _ = await queue.get()
+                partials[self.scheme.index_of(blob)] = blob
+            with obs_trace.TRACER.span(
+                "beacon.verify",
+                attrs={"round": round, "partials": len(partials),
+                       "fused": True, "optimistic": True,
+                       "attempt": attempt},
+            ):
+                try:
+                    return await asyncio.to_thread(
+                        self.scheme.finalize_round_optimistic,
+                        self.pub_poly, msg, list(partials.values()),
+                        t, len(self.group),
+                    )
+                except tbls.ThresholdError:
+                    _optimistic_fallbacks.inc()
+                    ok = await asyncio.to_thread(
+                        self.scheme.verify_partials_batch,
+                        self.pub_poly, msg, list(partials.values()),
+                    )
+                    bad = [i for i, good in zip(list(partials), ok)
+                           if not good]
+                    if not bad:
+                        # red recovered check but every partial verifies:
+                        # a device fault — never publish the signature
+                        raise tbls.ThresholdError(
+                            "recovered check failed with all partials "
+                            "valid"
+                        )
+                    now = self.clock.now()
+                    for idx in bad:
+                        sender = self.manager.sender_of(idx)
+                        if sender:
+                            # revoking the round contribution too keeps
+                            # the liar out of round_complete's credit
+                            self.peer_ledger.record_invalid(
+                                sender, now, round=round
+                            )
+                        _partials_rejected.inc()
+                        del partials[idx]
+                        self.manager.evict(idx)
+                    self.log.warning(
+                        "optimistic finalize fell back",
+                        round=round, evicted=len(bad), attempt=attempt,
+                    )
+        raise tbls.ThresholdError(
+            f"no clean quorum after {FINALIZE_ATTEMPTS} attempts"
+        )
+
     def _schedule_resync(self) -> None:
         """Fire-and-forget chain sync (at most one in flight)."""
         if not self._running:
@@ -411,10 +536,23 @@ class BeaconHandler:
 
     async def _send_packet(self, node: Identity,
                            packet: BeaconPacket) -> None:
-        try:
-            await self.client.new_beacon(node, packet)
-        except Exception as exc:  # peer down — the threshold absorbs it
-            self.log.debug("broadcast failed", to=node.address, err=exc)
+        async with self._gossip_sem:
+            try:
+                await self.client.new_beacon(node, packet)
+                return
+            except Exception as exc:
+                self.log.debug("broadcast failed", to=node.address,
+                               err=exc)
+            # one short retry: a transient hiccup (peer mid-restart,
+            # dropped stream) shouldn't cost the round this signer's
+            # partial; a genuinely down peer is absorbed by the
+            # threshold exactly as before
+            await asyncio.sleep(GOSSIP_RETRY_DELAY)
+            try:
+                await self.client.new_beacon(node, packet)
+            except Exception as exc:
+                self.log.debug("broadcast retry failed",
+                               to=node.address, err=exc)
 
     # -- inbound RPCs ------------------------------------------------------
 
@@ -438,7 +576,8 @@ class BeaconHandler:
                 self.group.get_genesis_seed(), packet.round
             )
         with obs_trace.TRACER.span(
-            "beacon.partial_verify", trace_id=tid,
+            "beacon.partial_admit" if self._optimistic
+            else "beacon.partial_verify", trace_id=tid,
             attrs={"round": packet.round, "from": packet.from_address,
                    "node": self.cfg.public.address},
         ):
@@ -450,14 +589,24 @@ class BeaconHandler:
                 _partials_rejected.inc()
                 raise
             try:
-                msg = beacon_message(packet.prev_sig, packet.prev_round,
-                                     packet.round)
-                # heavy pairing math runs off the event loop so the gRPC
-                # server keeps answering during verification
-                await asyncio.to_thread(
-                    self.scheme.verify_partial, self.pub_poly, msg,
-                    packet.partial_sig,
-                )
+                if self._optimistic:
+                    # structural admit only — length, point decode,
+                    # identity rejection; NO pairing, zero device
+                    # dispatches.  Validity is settled at quorum by the
+                    # recovered-signature check (blame fallback evicts
+                    # and charges forgeries to this sender's address).
+                    self.scheme.check_partial_structure(
+                        packet.partial_sig
+                    )
+                else:
+                    msg = beacon_message(packet.prev_sig,
+                                         packet.prev_round, packet.round)
+                    # heavy pairing math runs off the event loop so the
+                    # gRPC server keeps answering during verification
+                    await asyncio.to_thread(
+                        self.scheme.verify_partial, self.pub_poly, msg,
+                        packet.partial_sig,
+                    )
             except Exception:
                 _partials_rejected.inc()
                 self.peer_ledger.record_invalid(
@@ -484,9 +633,12 @@ class BeaconHandler:
         if idx == self.index:
             return
         _partials_in.inc()
+        # the sender rides along so a forged partial discovered at
+        # finalize is blamed on the peer that DELIVERED it
         self.manager.add_partial(
             packet.round, packet.partial_sig,
             packet.prev_round, packet.prev_sig,
+            sender=packet.from_address,
         )
 
     def sync_chain_from(self, from_round: int) -> List[Beacon]:

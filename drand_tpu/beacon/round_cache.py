@@ -5,6 +5,17 @@ partial handling through one goroutine with a 1024-slot look-ahead buffer
 for future-round partials (:33) and dedups by signer index (:113-118).
 Here the asyncio event loop provides the serialization; the manager keeps
 one queue for the active round and buffers bounded future-round partials.
+
+Optimistic finalization (lazy partial verification) adds two duties:
+
+* every admitted partial remembers WHICH peer delivered it
+  (`sender_of`), because blame for a forged partial must land on the
+  sender's address, never on the claimed signer index — a malicious
+  peer must not be able to frame an honest signer;
+* a blamed signer slot can be `evict`ed, which frees the dedup slot and
+  re-offers a standby duplicate if one arrived — so a liar squatting an
+  honest signer's index (its garbage won the dedup race) cannot block
+  that signer's real partial from counting toward a clean quorum.
 """
 
 from __future__ import annotations
@@ -13,6 +24,10 @@ import asyncio
 from typing import Dict, List, Optional, Tuple
 
 MAX_LOOKAHEAD = 1024
+
+#: deduped duplicates kept per signer index for the active round, so an
+#: evicted (blamed) slot can be refilled from a second sender
+MAX_STANDBY = 4
 
 
 class RoundManager:
@@ -26,8 +41,12 @@ class RoundManager:
         self._queue: Optional[asyncio.Queue] = None
         self._seen: set = set()
         self._link: Optional[Tuple[int, bytes]] = None
-        self._future: Dict[int, List[Tuple[bytes, int, bytes]]] = {}
+        # internal buffers carry the sender as a 4th element; the public
+        # queue keeps the historical 3-tuple shape
+        self._future: Dict[int, List[tuple]] = {}
         self._buffered = 0
+        self._senders: Dict[int, str] = {}   # signer idx -> sender address
+        self._standby: Dict[int, List[tuple]] = {}
 
     def new_round(self, round: int, prev_round: Optional[int] = None,
                   prev_sig: Optional[bytes] = None) -> asyncio.Queue:
@@ -38,6 +57,8 @@ class RoundManager:
         self._round = round
         self._queue = asyncio.Queue()
         self._seen = set()
+        self._senders = {}
+        self._standby = {}
         self._link = (
             (prev_round, prev_sig) if prev_sig is not None else None
         )
@@ -49,7 +70,7 @@ class RoundManager:
             self._buffered -= len(self._future.pop(r))
         return self._queue
 
-    def _offer(self, entry: Tuple[bytes, int, bytes]) -> None:
+    def _offer(self, entry: tuple) -> None:
         if self._link is not None and (entry[1], entry[2]) != self._link:
             # wrong chain link: the signer is desynced and its partial
             # signs a different message.  Dropped WITHOUT consuming the
@@ -58,14 +79,21 @@ class RoundManager:
             return
         idx = self._index_of(entry[0])
         if idx in self._seen:
+            # keep a few alternates: if the queued partial turns out
+            # forged and gets evicted, a second sender's copy takes over
+            standby = self._standby.setdefault(idx, [])
+            if len(standby) < MAX_STANDBY:
+                standby.append(entry)
             return
         self._seen.add(idx)
+        self._senders[idx] = entry[3] if len(entry) > 3 else ""
         assert self._queue is not None
-        self._queue.put_nowait(entry)
+        self._queue.put_nowait(entry[:3])
 
     def add_partial(self, round: int, blob: bytes,
-                    prev_round: int, prev_sig: bytes) -> None:
-        entry = (blob, prev_round, prev_sig)
+                    prev_round: int, prev_sig: bytes,
+                    sender: str = "") -> None:
+        entry = (blob, prev_round, prev_sig, sender)
         if self._round is not None and round == self._round:
             self._offer(entry)
         elif (self._round is None or round > self._round) and \
@@ -73,3 +101,18 @@ class RoundManager:
             self._future.setdefault(round, []).append(entry)
             self._buffered += 1
         # else: stale round — drop
+
+    def sender_of(self, idx: int) -> str:
+        """Address of the peer whose partial currently holds signer slot
+        `idx` ("" when unknown) — the blame target for a forged partial."""
+        return self._senders.get(idx, "")
+
+    def evict(self, idx: int) -> None:
+        """A blamed partial is removed from the round pool: free the
+        signer's dedup slot and re-offer the next standby duplicate (a
+        different sender's copy of the same index), if any arrived."""
+        self._seen.discard(idx)
+        self._senders.pop(idx, None)
+        standby = self._standby.get(idx)
+        if standby:
+            self._offer(standby.pop(0))

@@ -179,6 +179,7 @@ def cmd_start(args) -> int:
             tls_cert=tls_cert,
             tls_key=tls_key,
             insecure=tls_cert is None,
+            partial_verify=args.partial_verify,
         )
         n = _load_certs_dir(cfg.cert_manager, args.certs_dir)
         if n:
@@ -758,6 +759,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="persistent XLA compile cache directory (default "
              "~/.cache/drand_tpu_xla; DRAND_TPU_COMPILE_CACHE overrides; "
              "'off' disables)",
+    )
+    env_pv = os.environ.get("DRAND_TPU_PARTIAL_VERIFY", "optimistic")
+    if env_pv not in ("eager", "optimistic"):
+        raise SystemExit(
+            f"DRAND_TPU_PARTIAL_VERIFY={env_pv!r}: must be eager or "
+            "optimistic"
+        )
+    g.add_argument(
+        "--partial-verify", choices=["eager", "optimistic"],
+        default=env_pv, dest="partial_verify",
+        help="inbound partial policy: optimistic = structural admit + "
+             "one recovered-signature check at quorum with a batched "
+             "blame fallback (default; DRAND_TPU_PARTIAL_VERIFY "
+             "overrides); eager = pairing check per partial at arrival",
     )
     g.set_defaults(fn=cmd_start)
 

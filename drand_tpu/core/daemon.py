@@ -93,6 +93,11 @@ class Config:
     verify_max_wait: float = 0.005       # flush latency bound (s)
     verify_max_queue: int = 1024         # admission bound, then shed
     verify_cache_size: int = 4096        # LRU verified-round entries
+    #: inbound-partial policy: "optimistic" (structural admit + one
+    #: recovered-signature check at quorum, blame fallback on failure)
+    #: or "eager" (pairing check per partial at arrival — the fallback
+    #: knob if optimistic finalization misbehaves in the field)
+    partial_verify: str = "optimistic"
 
 
 class Drand:
@@ -493,6 +498,7 @@ class Drand:
             share=self.share,
             scheme=self.scheme,
             clock=self.clock,
+            partial_verify=self.cfg.partial_verify,
         )
         # the chain store survives handler swaps (resharing must keep the
         # already-produced chain, especially for in-memory stores)

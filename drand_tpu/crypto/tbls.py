@@ -89,6 +89,16 @@ class Scheme:
         """Raise ThresholdError if the partial is invalid."""
         raise NotImplementedError
 
+    def check_partial_structure(self, partial: bytes) -> int:
+        """Cheap structural admit gate for the optimistic ingest path:
+        length, point decode (on curve, right subgroup) and the identity
+        rejection — everything EXCEPT the pairing.  Returns the claimed
+        signer index; raises ThresholdError on anything a peer could
+        forge for free.  Zero device dispatches by contract — the test
+        suite asserts it against `obs.kernels.counters()`."""
+        idx, _ = _unpack_partial(partial)
+        return idx
+
     def recover(self, pub: PubPoly, msg: bytes,
                 partials: Sequence[bytes], t: int, n: int) -> bytes:
         raise NotImplementedError
@@ -108,6 +118,29 @@ class Scheme:
         `JaxScheme` overrides it with a fused device pipeline (batched
         partial check + MSM recovery + recovered-signature check in at
         most two dispatches).
+        """
+        sig = self.recover(pub, msg, partials, t, n)
+        self.verify_recovered(pub.commit(), msg, sig)
+        return sig
+
+    def finalize_round_optimistic(self, pub: PubPoly, msg: bytes,
+                                  partials: Sequence[bytes], t: int,
+                                  n: int) -> bytes:
+        """Optimistic round finalize: Lagrange-recover from the first t
+        admitted partials and verify ONLY the recovered signature against
+        the collective key — no per-partial pairing anywhere.  Partials
+        here were admitted by `check_partial_structure` only, so a wrong
+        share surfaces as a red recovered check (`ThresholdError`); the
+        caller then runs `verify_partials_batch` over the same subset to
+        identify and evict the liars (the blame fallback).
+
+        BLS recovery from ANY t valid shares of the same message yields
+        the one group signature, so a successful optimistic finalize is
+        byte-identical to the eager `finalize_round` output.
+
+        The base implementation composes `recover` + `verify_recovered`
+        (Ref/Native: one MSM + one pairing); `JaxScheme` overrides it
+        with the single fused MSM→affine→check dispatch.
         """
         sig = self.recover(pub, msg, partials, t, n)
         self.verify_recovered(pub.commit(), msg, sig)
@@ -270,6 +303,22 @@ class NativeScheme(Scheme):
         with kernel_span("g2_sign", backend="native", batch=1):
             sig = self._nb.sign(msg, share.value)
         return share.index.to_bytes(INDEX_LEN, "big") + sig
+
+    def check_partial_structure(self, partial: bytes) -> int:
+        # bytes-level C++ subgroup check instead of the base class's
+        # pure-Python point decode: same acceptance set, microseconds
+        if len(partial) != INDEX_LEN + SIG_LEN:
+            raise ThresholdError(
+                f"partial must be {INDEX_LEN + SIG_LEN} bytes, "
+                f"got {len(partial)}"
+            )
+        idx = int.from_bytes(partial[:INDEX_LEN], "big")
+        sig = partial[INDEX_LEN:]
+        if sig == self._IDENT96:
+            raise ThresholdError("identity signature rejected")
+        if self._nb.g2_check(sig) != 0:
+            raise ThresholdError("malformed partial: bad G2 point")
+        return idx
 
     def verify_partial(self, pub: PubPoly, msg: bytes,
                        partial: bytes) -> None:
@@ -790,6 +839,43 @@ class JaxScheme(Scheme):
             # mathematically unreachable when the t inputs passed the
             # row check above; kept as defense in depth (a device fault
             # must never publish a bad beacon)
+            raise ThresholdError("invalid recovered signature")
+        out = (self._tower.fp2_decode(sig_host[0]),
+               self._tower.fp2_decode(sig_host[1]))
+        return ref.g2_to_bytes(out)
+
+    def finalize_round_optimistic(self, pub: PubPoly, msg: bytes,
+                                  partials: Sequence[bytes], t: int,
+                                  n: int) -> bytes:
+        """ONE device dispatch: the fused MSM→affine→recovered-check
+        program over the first t admitted partials, skipping the
+        per-partial pairing batch entirely.  With the per-round H(m)
+        already cached by `partial_sign`, the whole honest round costs
+        two dispatches total (g2_sign + msm_recover) and zero pairing
+        work at ingest.  A red in-program check means at least one
+        admitted partial was forged — raised as ThresholdError so the
+        handler can run the `verify_partials_batch` blame pass."""
+        plan = self._plan(pub)
+        chosen = self._recover_indices(partials, t)
+        lam = lagrange_basis_at_zero([i for i, _ in chosen])
+        pts = self._curve.g2_encode_batch([pt for _, pt in chosen])
+        bits = self._jnp.asarray(
+            np.stack(
+                [self._curve.scalar_to_bits(lam[i]) for i, _ in chosen]
+            )
+        )
+        q2 = self._msg_q2(msg)
+        if self._finalize_jit is None:
+            self._finalize_jit = self._build_finalize()
+        with kernel_span("msm_recover", backend="jax",
+                         batch=len(chosen), fused_verify=True,
+                         optimistic=True):
+            sig_aff, good = self._finalize_jit(
+                pts, bits, plan.neg_g_row, plan.pk_row, q2
+            )
+            good = bool(np.asarray(good))
+            sig_host = np.asarray(sig_aff)
+        if not good:
             raise ThresholdError("invalid recovered signature")
         out = (self._tower.fp2_decode(sig_host[0]),
                self._tower.fp2_decode(sig_host[1]))

@@ -216,6 +216,73 @@ def build_public_server(daemon, address: str,
                 ))
         return pb.VerifyBeaconBatchResponse(items=out)
 
+    async def verify_beacon_stream(request_iterator, context):
+        """Bidirectional verification pipeline: relays push claims as
+        fast as they arrive and read results as they resolve, no
+        per-request HTTP/unary framing in between.  Each claim carries a
+        client-chosen `claim_id`; responses demux by it and may come
+        back OUT OF ORDER — a claim that hits the verified-round cache
+        answers immediately while an earlier one waits on its batch."""
+        from drand_tpu import serve
+
+        gw = await _verify_gateway(context)
+        client = context.peer()
+        results: asyncio.Queue = asyncio.Queue()
+        _DONE = object()
+
+        async def run_one(msg):
+            req = serve.VerifyRequest(
+                round=msg.round,
+                prev_round=msg.previous_round,
+                prev_sig=msg.previous_signature,
+                signature=msg.signature,
+            )
+            try:
+                res = await gw.verify(
+                    req, msg.timeout_seconds or None, client=client,
+                    trace_id=msg.trace_id or None,
+                )
+                resp = pb.VerifyBeaconResponse(
+                    claim_id=msg.claim_id, valid=res.valid,
+                    cached=res.cached, batch_size=res.batch_size,
+                )
+            except serve.Oversize:
+                resp = pb.VerifyBeaconResponse(
+                    claim_id=msg.claim_id, error="oversize")
+            except serve.Overloaded:
+                resp = pb.VerifyBeaconResponse(
+                    claim_id=msg.claim_id, error="overloaded")
+            except serve.DeadlineExceeded:
+                resp = pb.VerifyBeaconResponse(
+                    claim_id=msg.claim_id, error="deadline exceeded")
+            except serve.GatewayClosed:
+                resp = pb.VerifyBeaconResponse(
+                    claim_id=msg.claim_id, error="unavailable")
+            await results.put(resp)
+
+        async def pump():
+            inflight = set()
+            try:
+                async for msg in request_iterator:
+                    t = asyncio.create_task(run_one(msg))
+                    inflight.add(t)
+                    t.add_done_callback(inflight.discard)
+                if inflight:
+                    await asyncio.gather(*inflight,
+                                         return_exceptions=True)
+            finally:
+                await results.put(_DONE)
+
+        pump_task = asyncio.create_task(pump())
+        try:
+            while True:
+                resp = await results.get()
+                if resp is _DONE:
+                    break
+                yield resp
+        finally:
+            pump_task.cancel()
+
     async def setup(request, context):
         await _dkg_inbound(daemon, request, context, reshare=False)
         return pb.Empty()
@@ -261,6 +328,11 @@ def build_public_server(daemon, address: str,
             response_serializer=(
                 pb.VerifyBeaconBatchResponse.SerializeToString
             ),
+        ),
+        "VerifyBeaconStream": grpc.stream_stream_rpc_method_handler(
+            verify_beacon_stream,
+            request_deserializer=pb.VerifyBeaconRequest.FromString,
+            response_serializer=pb.VerifyBeaconResponse.SerializeToString,
         ),
     }
     protocol_handlers = {
@@ -659,6 +731,44 @@ class GrpcClient(ProtocolClient):
             req, timeout=(timeout or 0.0) + CONTROL_TIMEOUT
         )
         return list(resp.items)
+
+    async def verify_beacon_stream(self, peer: Identity, items,
+                                   timeout: Optional[float] = None):
+        """Pipelined verification: `items` is an (async or sync)
+        iterable of dicts with keys claim_id/round/prev_round/prev_sig/
+        signature.  Claims stream into the peer's batcher as they are
+        produced; responses are yielded AS THEY RESOLVE, demuxed by the
+        client-supplied `claim_id` (order is not preserved — that is the
+        point: a cache hit answers while an earlier claim still batches).
+        """
+        ch = self._cache.get(peer.address, peer.tls)
+        call = ch.stream_stream(
+            f"/{PUBLIC_SERVICE}/VerifyBeaconStream",
+            request_serializer=pb.VerifyBeaconRequest.SerializeToString,
+            response_deserializer=pb.VerifyBeaconResponse.FromString,
+        )
+
+        async def requests():
+            if hasattr(items, "__aiter__"):
+                async for i in items:
+                    yield _stream_claim(i, timeout)
+            else:
+                for i in items:
+                    yield _stream_claim(i, timeout)
+
+        async for resp in call(requests()):
+            yield resp
+
+
+def _stream_claim(i: dict, timeout: Optional[float]):
+    return pb.VerifyBeaconRequest(
+        claim_id=i["claim_id"],
+        round=i["round"],
+        previous_round=i["prev_round"],
+        previous_signature=i["prev_sig"],
+        signature=i["signature"],
+        timeout_seconds=timeout or 0.0,
+    )
 
 
 class ControlClient:

@@ -169,11 +169,23 @@ class PeerLedger:
                 )
         _latency_hist(address).observe(latency)
 
-    def record_invalid(self, address: str, ts: float) -> None:
-        """A partial from `address` failed signature verification."""
+    def record_invalid(self, address: str, ts: float,
+                       round: Optional[int] = None) -> None:
+        """A partial from `address` failed signature verification.
+
+        With `round` given, the peer's optimistically-recorded
+        contribution for that round is revoked too: the lazy admit path
+        counts a partial on arrival, so a forgery unmasked by the
+        finalize blame pass must also lose its round credit — otherwise
+        the liar never accrues misses and its suspect score stays soft.
+        """
         with self._lock:
             st = self._get(address)
             st.invalid += 1
+            if round is not None:
+                got = self._round_partials.get(round)
+                if got is not None:
+                    got.discard(address)
         _invalid_counter(address).inc()
 
     def round_complete(self, round: int,

@@ -75,7 +75,8 @@ class LocalNet(ProtocolClient):
             yield b
 
 
-def build_network(n, t, clock, scheme=None, seed=5):
+def build_network(n, t, clock, scheme=None, seed=5,
+                  partial_verify="optimistic"):
     r = random.Random(seed)
     pairs = [
         Pair.generate(f"127.0.0.1:{9000 + i}", rng=r.randbytes)
@@ -97,6 +98,7 @@ def build_network(n, t, clock, scheme=None, seed=5):
         cfg = BeaconConfig(
             group=group, public=pair.public, share=share,
             scheme=scheme, clock=clock,
+            partial_verify=partial_verify,
         )
         h = BeaconHandler(cfg, BeaconStore(), net)
         net.register(pair.public.address, h)

@@ -183,7 +183,10 @@ async def test_two_node_round_stitches_into_one_trace():
         # both nodes' pipelines and the cross-node partial verifies
         assert names.count("beacon.round") == 2
         assert names.count("beacon.sign") == 2
-        assert "beacon.partial_verify" in names
+        # default optimistic mode admits partials structurally; the
+        # eager fallback knob still emits beacon.partial_verify
+        assert ("beacon.partial_admit" in names
+                or "beacon.partial_verify" in names)
         assert all(s["trace_id"] == tid for s in t["spans"])
     finally:
         for h in handlers:

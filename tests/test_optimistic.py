@@ -1,0 +1,482 @@
+"""Optimistic quorum finalization: the lazy-admit hot path.
+
+Covers the PR's contract surface end to end:
+
+* crypto layer — structural admit accepts exactly what a pairing check
+  would (minus forgeries), the optimistic finalize is byte-identical to
+  the eager one, and a forged partial poisons recovery in a way the
+  blame pass can localize;
+* dispatch accounting — ZERO device dispatches at ingest and at most
+  two per finalize, asserted against `obs.kernels.counters()`;
+* round manager — sender tracking, evict + standby takeover (a liar
+  squatting an honest signer's index cannot block that signer);
+* network — optimistic and eager networks produce byte-identical
+  chains; a malicious signer's network still finalizes every round,
+  the fallback counter moves, and blame lands on the liar's address
+  (never on an honest peer);
+* regression — a finalize that fails with every partial valid (device
+  fault) abandons the attempt gracefully instead of crashing the loop.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from drand_tpu.beacon import verify_beacon
+from drand_tpu.beacon import handler as handler_mod
+from drand_tpu.beacon.round_cache import MAX_STANDBY, RoundManager
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.poly import PriPoly
+from drand_tpu.key import Share
+from drand_tpu.obs import kernels
+from drand_tpu.utils.clock import FakeClock
+
+from test_beacon import PERIOD, build_network, wait_for_round
+
+slow = pytest.mark.slow
+
+MSG = b"drand-tpu optimistic round message"
+
+
+def fixed_poly(t, seed):
+    r = random.Random(seed)
+    return PriPoly.random(t, rng=r.randbytes)
+
+
+def native_or_skip():
+    scheme = tbls._native_scheme_or_ref()
+    if not isinstance(scheme, tbls.NativeScheme):
+        pytest.skip("native BLS backend unavailable")
+    return scheme
+
+
+# -- structural admit gate (crypto layer) -----------------------------------
+
+
+def test_structural_check_accepts_valid_rejects_garbage():
+    """The admit gate must reject everything a peer can forge for free
+    (length, encoding, identity) while letting through any well-formed
+    G2 point — including a forgery signed under the WRONG share, whose
+    unmasking is the finalize blame pass's job, not ingest's."""
+    scheme = tbls._native_scheme_or_ref()
+    t, n = 2, 3
+    poly = fixed_poly(t, 41)
+    partials = [scheme.partial_sign(s, MSG) for s in poly.shares(n)]
+    for i, p in enumerate(partials):
+        assert scheme.check_partial_structure(p) == i
+
+    with pytest.raises(tbls.ThresholdError):
+        scheme.check_partial_structure(b"short")
+    with pytest.raises(tbls.ThresholdError):
+        scheme.check_partial_structure(b"\x00\x01" + b"\xff" * 96)
+    identity = b"\x00\x00" + bytes([0xC0]) + bytes(95)
+    with pytest.raises(tbls.ThresholdError):
+        scheme.check_partial_structure(identity)
+
+    # a forgery (valid point, wrong key) sails through the admit gate...
+    evil = fixed_poly(t, 42)
+    forged = scheme.partial_sign(evil.eval(0), MSG)
+    assert scheme.check_partial_structure(forged) == 0
+    # ...and the blame pass is what localizes it
+    pub = poly.commit()
+    ok = scheme.verify_partials_batch(
+        pub, MSG, [forged, partials[1], partials[2]]
+    )
+    assert ok == [False, True, True]
+
+
+def test_optimistic_finalize_byte_identical_and_poisoned_by_forgery():
+    """BLS recovery from any t valid shares of one message yields THE
+    unique group signature, so the optimistic output must equal the
+    eager one byte for byte; a forged partial in the chosen subset must
+    surface as a red recovered check."""
+    scheme = native_or_skip()
+    t, n = 3, 4
+    poly = fixed_poly(t, 43)
+    pub = poly.commit()
+    partials = [scheme.partial_sign(s, MSG) for s in poly.shares(n)]
+
+    eager = scheme.finalize_round(pub, MSG, partials, t, n)
+    lazy = scheme.finalize_round_optimistic(pub, MSG, partials, t, n)
+    assert eager == lazy
+    # any t-subset recovers the same signature
+    assert scheme.finalize_round_optimistic(
+        pub, MSG, partials[1:], t, n
+    ) == eager
+    scheme.verify_recovered(pub.commit(), MSG, lazy)
+
+    evil = fixed_poly(t, 44)
+    forged = scheme.partial_sign(evil.eval(1), MSG)
+    with pytest.raises(tbls.ThresholdError):
+        scheme.finalize_round_optimistic(
+            pub, MSG, [partials[0], forged, partials[2]], t, n
+        )
+
+
+def test_native_ingest_zero_dispatches_finalize_at_most_two():
+    """The dispatch contract, from the kernel counters themselves:
+    structural admits cost ZERO device dispatches, and one optimistic
+    finalize costs at most two (MSM recover + recovered-sig pairing)."""
+    scheme = native_or_skip()
+    t, n = 3, 4
+    poly = fixed_poly(t, 45)
+    pub = poly.commit()
+    partials = [scheme.partial_sign(s, MSG) for s in poly.shares(n)]
+
+    kernels.reset_counters()
+    for p in partials:
+        scheme.check_partial_structure(p)
+    assert kernels.counters() == {}, "ingest must not touch the device"
+
+    sig = scheme.finalize_round_optimistic(pub, MSG, partials, t, n)
+    c = kernels.counters()
+    assert c.get("pairing_check", {}).get("dispatches", 0) == 1
+    assert sum(st["dispatches"] for st in c.values()) <= 2
+    assert sig == tbls.RefScheme().recover(pub, MSG, partials, t, n)
+
+
+@slow
+def test_jax_optimistic_single_fused_dispatch():
+    """JaxScheme folds the whole optimistic finalize — MSM, affine
+    conversion and the recovered-signature pairing — into ONE fused
+    dispatch, with no separate pairing_check kernel; output stays
+    byte-identical to the oracle recovery and the eager path."""
+    # native backend as the oracle (byte-identical to RefScheme, see
+    # tests/test_native_bls.py) keeps this test's budget to the XLA
+    # compile alone instead of minutes of pure-Python pairings
+    oracle = tbls._native_scheme_or_ref()
+    jscheme = tbls.JaxScheme()
+    t, n = 2, 3
+    poly = fixed_poly(t, 46)
+    pub = poly.commit()
+    partials = [oracle.partial_sign(s, MSG) for s in poly.shares(n)]
+    want = oracle.recover(pub, MSG, partials, t, n)
+
+    # warm call: XLA compile + H(m) cache fill
+    assert jscheme.finalize_round_optimistic(
+        pub, MSG, partials, t, n
+    ) == want
+
+    kernels.reset_counters()
+    assert jscheme.finalize_round_optimistic(
+        pub, MSG, partials, t, n
+    ) == want
+    c = kernels.counters()
+    assert set(c) == {"msm_recover"}, c
+    assert c["msm_recover"]["dispatches"] == 1
+
+    assert jscheme.finalize_round(pub, MSG, partials, t, n) == want
+
+    # a forged partial inside the chosen subset turns the fused check red
+    evil = fixed_poly(t, 47)
+    forged = oracle.partial_sign(evil.eval(0), MSG)
+    with pytest.raises(tbls.ThresholdError):
+        jscheme.finalize_round_optimistic(
+            pub, MSG, [forged, partials[1]], t, n
+        )
+
+
+# -- round manager: sender tracking + evict/standby -------------------------
+
+
+@pytest.mark.asyncio
+async def test_round_manager_sender_tracking_evict_and_standby():
+    mgr = RoundManager(lambda b: b[0])
+    q = mgr.new_round(7, 6, b"link")
+    mgr.add_partial(7, bytes([2]) + b"from-A", 6, b"link", sender="A")
+    mgr.add_partial(7, bytes([2]) + b"from-B", 6, b"link", sender="B")
+    assert q.qsize() == 1          # duplicate parked on standby
+    assert mgr.sender_of(2) == "A"
+    blob, pr, ps = q.get_nowait()
+    assert blob == bytes([2]) + b"from-A" and (pr, ps) == (6, b"link")
+
+    # blamed: the standby copy (another sender!) takes the slot over
+    mgr.evict(2)
+    assert q.qsize() == 1
+    blob2, _, _ = q.get_nowait()
+    assert blob2 == bytes([2]) + b"from-B"
+    assert mgr.sender_of(2) == "B"
+
+    # no standby left: the slot frees entirely, a later sender refills
+    mgr.evict(2)
+    assert mgr.sender_of(2) == ""
+    mgr.add_partial(7, bytes([2]) + b"from-C", 6, b"link", sender="C")
+    assert q.qsize() == 1 and mgr.sender_of(2) == "C"
+
+    # standby depth is bounded
+    for s in ("D", "E", "F", "G", "H", "I"):
+        mgr.add_partial(7, bytes([2]) + s.encode(), 6, b"link", sender=s)
+    assert len(mgr._standby[2]) == MAX_STANDBY
+
+    # queue entries stay 3-tuples; senders reset on a new round
+    q2 = mgr.new_round(8, 7, b"next")
+    assert mgr.sender_of(2) == ""
+    mgr.add_partial(8, bytes([3]) + b"x", 7, b"next", sender="Z")
+    assert q2.get_nowait() == (bytes([3]) + b"x", 7, b"next")
+
+
+def test_config_rejects_unknown_partial_verify_mode():
+    clock = FakeClock()
+    with pytest.raises(ValueError):
+        build_network(2, 2, clock, partial_verify="bogus")
+
+
+# -- network equivalence, dispatch budget, liar, device fault ---------------
+
+
+async def _run_chain(mode, rounds=3):
+    clock = FakeClock()
+    group, handlers, net, poly = build_network(
+        4, 3, clock, partial_verify=mode
+    )
+    for h in handlers:
+        await h.start()
+    await clock.advance(10)
+    await wait_for_round(handlers, 1)
+    for r in range(2, rounds + 1):
+        await clock.advance(PERIOD)
+        await wait_for_round(handlers, r)
+    chain = [handlers[0].store.get(r) for r in range(1, rounds + 1)]
+    for h in handlers:
+        await h.stop()
+    return chain, poly
+
+
+@pytest.mark.asyncio
+async def test_optimistic_and_eager_chains_byte_identical():
+    """Same seed, same fake-clock start: the optimistic network's chain
+    must match the eager network's byte for byte (the perf knob must
+    never change what gets published)."""
+    lazy_chain, poly = await _run_chain("optimistic")
+    eager_chain, _ = await _run_chain("eager")
+    assert [b.signature for b in lazy_chain] == \
+        [b.signature for b in eager_chain]
+    assert lazy_chain == eager_chain
+    dist_key = ref.g1_mul(ref.G1_GEN, poly.secret())
+    scheme = tbls._native_scheme_or_ref()
+    for b in lazy_chain:
+        verify_beacon(scheme, dist_key, b)
+
+
+@pytest.mark.asyncio
+async def test_honest_round_dispatch_budget():
+    """One honest network round in optimistic mode: no arrival-time
+    pairing dispatches anywhere — the only pairings are the single
+    recovered-signature check each node's finalize performs (eager mode
+    would dispatch one pairing per inbound partial on top)."""
+    native_or_skip()
+    clock = FakeClock()
+    group, handlers, net, poly = build_network(4, 3, clock)
+    for h in handlers:
+        await h.start()
+    try:
+        await clock.advance(10)
+        await wait_for_round(handlers, 1)
+        kernels.reset_counters()
+        await clock.advance(PERIOD)
+        await wait_for_round(handlers, 2)
+        c = kernels.counters()
+        pairings = c.get("pairing_check", {}).get("dispatches", 0)
+        recovers = c.get("msm_recover", {}).get("dispatches", 0)
+        assert 1 <= pairings <= len(handlers), c
+        assert 1 <= recovers <= len(handlers), c
+    finally:
+        for h in handlers:
+            await h.stop()
+
+
+@pytest.mark.asyncio
+async def test_liar_cannot_block_rounds_and_tops_suspects():
+    """n=4 t=3 with one node signing under a corrupted share.  Its
+    partials pass the structural admit and land in every quorum (the
+    delivery bias below makes sure of it), so every node's finalize
+    goes through the blame fallback — yet EVERY round still finalizes,
+    the fallback counter moves, blame lands on the liar's address, and
+    no honest peer is ever framed."""
+    clock = FakeClock()
+    group, handlers, net, poly = build_network(4, 3, clock)
+    liar = handlers[3]
+    liar_addr = liar.cfg.public.address
+    honest = handlers[:3]
+    honest_addrs = {h.cfg.public.address for h in honest}
+
+    # the liar signs with a share from a DIFFERENT polynomial: valid G2
+    # points (admit gate passes), garbage under the committee key
+    evil = fixed_poly(3, 1234)
+    liar.cfg.share = Share(commits=poly.commit().commits,
+                           share=evil.eval(3))
+
+    # delivery bias: the liar's packets arrive instantly, honest ones a
+    # beat later — every node's first quorum deterministically contains
+    # the liar's partial, forcing the fallback every round
+    orig_send = net.new_beacon
+
+    async def biased(peer, packet):
+        if packet.from_address != liar_addr:
+            await asyncio.sleep(0.2)
+        await orig_send(peer, packet)
+
+    net.new_beacon = biased
+
+    fallbacks_before = handler_mod._optimistic_fallbacks.value
+    for h in handlers:
+        await h.start()
+    try:
+        await clock.advance(10)
+        await wait_for_round(handlers, 1)
+        for r in (2, 3):
+            await clock.advance(PERIOD)
+            await wait_for_round(handlers, r)
+    finally:
+        for h in handlers:
+            await h.stop()
+
+    # every round finalized on every node, including the liar's
+    for h in handlers:
+        assert h.store.last().round >= 3
+
+    # the chain is the honest chain (verifies under the committee key)
+    dist_key = ref.g1_mul(ref.G1_GEN, poly.secret())
+    scheme = tbls._native_scheme_or_ref()
+    for r in range(1, 4):
+        verify_beacon(scheme, dist_key, honest[0].store.get(r))
+
+    # the optimistic path actually fell back
+    assert handler_mod._optimistic_fallbacks.value > fallbacks_before
+
+    now = clock.now()
+    for h in honest:
+        snap = h.peer_ledger.snapshot(now)
+        # blame landed on the liar's ADDRESS...
+        assert snap[liar_addr]["invalid"] >= 1, snap[liar_addr]
+        # ...and never on an honest peer (no framing by signer index)
+        for addr in honest_addrs - {h.cfg.public.address}:
+            assert snap[addr]["invalid"] == 0, (addr, snap[addr])
+        # the liar tops the suspect ranking
+        suspects = h.peer_ledger.suspects(now)
+        assert suspects and suspects[0]["peer"] == liar_addr, suspects
+
+
+class _DeviceFaultScheme:
+    """Wrapper injecting the worst case: the recovered check goes red
+    while every partial verifies — the signature must NOT be published
+    and the round loop must survive."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def finalize_round_optimistic(self, *a, **k):
+        self.calls += 1
+        raise tbls.ThresholdError("injected device fault")
+
+
+@pytest.mark.asyncio
+async def test_finalize_device_fault_abandons_round_gracefully():
+    """Regression: when finalize raises with an unrecoverable quorum
+    (blame pass finds nothing to evict), the attempt is counted, logged
+    and abandoned — the loop stays alive and the node rejoins the chain
+    once the fault clears."""
+    clock = FakeClock()
+    group, handlers, net, poly = build_network(4, 3, clock)
+    for h in handlers:
+        await h.start()
+    try:
+        await clock.advance(10)
+        await wait_for_round(handlers, 1)
+
+        victim = handlers[0]
+        real = victim.scheme
+        faulty = _DeviceFaultScheme(real)
+        victim.scheme = faulty
+        failed_before = handler_mod._rounds_failed.value
+
+        await clock.advance(PERIOD)
+        await wait_for_round(handlers[1:], 2)
+        # the victim's finalize must have hit the fault and bailed
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 60.0
+        while loop.time() < deadline and faulty.calls == 0:
+            await asyncio.sleep(0.02)
+        assert faulty.calls >= 1
+
+        assert victim.store.last().round == 1   # nothing bogus stored
+        assert handler_mod._rounds_failed.value > failed_before
+        assert victim._loop_task is not None
+        assert not victim._loop_task.done(), "round loop died"
+
+        # fault clears: the node catches back up within a few ticks
+        victim.scheme = real
+        rejoined = False
+        for _ in range(4):
+            await clock.advance(PERIOD)
+            try:
+                await wait_for_round(
+                    [victim], handlers[1].store.last().round, timeout=90
+                )
+                rejoined = True
+                break
+            except TimeoutError:
+                continue
+        assert rejoined, f"victim stuck at {victim.store.last()}"
+    finally:
+        for h in handlers:
+            await h.stop()
+
+
+# -- streaming verification endpoint ----------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_verify_beacon_stream_demuxes_by_claim_id():
+    """The bidirectional relay endpoint: claims stream in, verdicts
+    stream out demuxed by the client-chosen claim_id (order not
+    guaranteed), invalid and valid interleaved on one call."""
+    from drand_tpu.key import Identity
+    from drand_tpu.net.tls import CertManager
+    from drand_tpu.net.transport import GrpcClient, build_public_server
+    from drand_tpu.serve import VerifyGateway
+
+    class StubScheme:
+        def verify_chain_batch(self, pub, msgs, sigs):
+            return [s.startswith(b"ok") for s in sigs]
+
+    class FakeDaemon:
+        def __init__(self, gw):
+            self._gw = gw
+
+        async def verify_gateway(self):
+            return self._gw
+
+    async with VerifyGateway(object(), StubScheme(),
+                             max_wait=0.02) as gw:
+        server, port = build_public_server(FakeDaemon(gw), "127.0.0.1:0")
+        await server.start()
+        client = GrpcClient(CertManager())
+        try:
+            peer = Identity(address=f"127.0.0.1:{port}", key=None,
+                            tls=False)
+            items = [
+                {"claim_id": 100 + r, "round": r, "prev_round": r - 1,
+                 "prev_sig": b"\x01" * 96,
+                 "signature": ((b"ok" if r % 2 else b"no")
+                               + r.to_bytes(8, "big"))}
+                for r in range(11, 16)
+            ]
+            got = {}
+            async for resp in client.verify_beacon_stream(
+                peer, items, timeout=10.0
+            ):
+                got[resp.claim_id] = resp
+            assert set(got) == {100 + r for r in range(11, 16)}
+            for r in range(11, 16):
+                assert got[100 + r].valid == bool(r % 2), r
+                assert not got[100 + r].error
+        finally:
+            await client.close()
+            await server.stop(0.1)

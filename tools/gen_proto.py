@@ -116,12 +116,14 @@ def build_file() -> dp.FileDescriptorProto:
                  field("previous_signature", 3, BYT),
                  field("signature", 4, BYT),
                  field("timeout_seconds", 5, DBL),
-                 field("trace_id", 6, STR)))
+                 field("trace_id", 6, STR),
+                 field("claim_id", 7, U64)))
     m.append(msg("VerifyBeaconResponse",
                  field("valid", 1, BOO),
                  field("cached", 2, BOO),
                  field("batch_size", 3, U32),
-                 field("error", 4, STR)))
+                 field("error", 4, STR),
+                 field("claim_id", 5, U64)))
     m.append(msg("VerifyBeaconBatchRequest",
                  field("items", 1, F.TYPE_MESSAGE, REP,
                        type_name=".drandtpu.VerifyBeaconRequest"),
