@@ -25,7 +25,7 @@ from __future__ import annotations
 import asyncio
 import random
 from dataclasses import dataclass, field
-from typing import AsyncIterator, Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from drand_tpu.beacon.chain import (
     Beacon,
@@ -38,6 +38,13 @@ from drand_tpu.beacon.chain import (
 from drand_tpu.beacon.round_cache import RoundManager
 from drand_tpu.beacon.store import BeaconStore, CallbackStore
 from drand_tpu.crypto import tbls
+# BeaconPacket/ProtocolClient live in net/interface.py (transport
+# interface extraction); re-exported here because this was their
+# historical home and every transport/test imports them from it
+from drand_tpu.net.interface import (  # noqa: F401
+    BeaconPacket,
+    ProtocolClient,
+)
 from drand_tpu.key import Group, Identity, Share
 from drand_tpu.obs import peers as obs_peers
 from drand_tpu.obs import slo as obs_slo
@@ -97,59 +104,6 @@ FINALIZE_ATTEMPTS = 8
 
 
 @dataclass
-class BeaconPacket:
-    """Wire content of a partial-signature broadcast (NewBeacon RPC)."""
-
-    from_address: str
-    round: int
-    prev_round: int
-    prev_sig: bytes
-    partial_sig: bytes
-    #: distributed-trace id of the round this partial belongs to; every
-    #: group member derives the same value, but carrying it on the wire
-    #: lets out-of-group observers stitch too (and survives seed drift)
-    trace_id: str = ""
-    #: sender's clock at send time (unix seconds; 0 = not carried) — the
-    #: receiver's peer ledger estimates clock skew from recv - sent_at
-    sent_at: float = 0.0
-
-    def to_dict(self) -> dict:
-        return {
-            "from_address": self.from_address,
-            "round": self.round,
-            "prev_round": self.prev_round,
-            "prev_sig": self.prev_sig.hex(),
-            "partial_sig": self.partial_sig.hex(),
-            "trace_id": self.trace_id,
-            "sent_at": self.sent_at,
-        }
-
-    @classmethod
-    def from_dict(cls, d: dict) -> "BeaconPacket":
-        return cls(
-            from_address=d["from_address"],
-            round=int(d["round"]),
-            prev_round=int(d["prev_round"]),
-            prev_sig=bytes.fromhex(d["prev_sig"]),
-            partial_sig=bytes.fromhex(d["partial_sig"]),
-            trace_id=d.get("trace_id", ""),
-            sent_at=float(d.get("sent_at", 0.0)),
-        )
-
-
-class ProtocolClient:
-    """Outbound protocol-plane transport (gRPC or in-process loopback)."""
-
-    async def new_beacon(self, peer: Identity,
-                         packet: BeaconPacket) -> None:
-        raise NotImplementedError
-
-    def sync_chain(self, peer: Identity,
-                   from_round: int) -> AsyncIterator[Beacon]:
-        raise NotImplementedError
-
-
-@dataclass
 class BeaconConfig:
     group: Group
     public: Identity
@@ -166,6 +120,22 @@ class BeaconConfig:
     #: when it fails; "eager": every inbound partial pays a pairing
     #: check at arrival time (the pre-optimization behavior)
     partial_verify: str = "optimistic"
+    #: how heavy crypto leaves the event loop: None = asyncio.to_thread
+    #: (production).  The simulator injects an inline awaitable runner so
+    #: the whole network is single-threaded and cooperatively scheduled —
+    #: thread wake-up order is the one nondeterminism a seeded replay
+    #: cannot pin down.
+    offload: Optional[Callable] = None
+    #: source of protocol-level randomness (peer shuffle order during
+    #: catch-up).  None = the process-global `random` module; the
+    #: simulator injects a per-node seeded random.Random.
+    rng: Optional[random.Random] = None
+
+    def __post_init__(self):
+        # fail at configuration time, not mid-round: a bad SLO override
+        # in the group file must surface when the group is loaded
+        obs_slo.parse_overrides(getattr(self.group, "slo", None) or [],
+                                period=self.group.period)
 
 
 class BeaconHandler:
@@ -188,6 +158,12 @@ class BeaconHandler:
                 f"got {cfg.partial_verify!r}"
             )
         self._optimistic = cfg.partial_verify == "optimistic"
+        #: heavy crypto runs through this (default: a worker thread); the
+        #: simulator injects an inline runner for determinism
+        self._offload = cfg.offload or asyncio.to_thread
+        #: protocol randomness (sync peer order); the module-level random
+        #: in production, a per-node seeded Random in the simulator
+        self._rng = cfg.rng or random
         self._gossip_sem = asyncio.Semaphore(GOSSIP_CONCURRENCY)
         self.pub_poly = cfg.share.pub_poly()
         self.dist_key = cfg.share.public().key()
@@ -200,6 +176,13 @@ class BeaconHandler:
             (n.address for n in cfg.group.nodes),
             cfg.public.address, cfg.group.period,
         )
+        # group-file SLO overrides land first: ENGINE.objective is
+        # first-registration-wins, so whatever the group TOML declares
+        # beats the built-in defaults below (and any other node module's)
+        for name, kw in obs_slo.parse_overrides(
+                getattr(cfg.group, "slo", None) or [],
+                period=cfg.group.period).items():
+            obs_slo.ENGINE.objective(name, **kw)
         # SLO: the chain's reason to exist is randomness on schedule, so
         # the objective is phrased against the round's own deadline
         obs_slo.ENGINE.objective(
@@ -347,7 +330,7 @@ class BeaconHandler:
         # (asyncio.to_thread copies the contextvars context, so kernel
         # spans opened inside the scheme parent to the stage span.)
         with obs_trace.TRACER.span("beacon.sign", attrs={"round": round}):
-            own = await asyncio.to_thread(
+            own = await self._offload(
                 self.scheme.partial_sign, self.cfg.share.share, msg
             )
         queue = self.manager.new_round(round, prev_round, prev_sig)
@@ -467,7 +450,7 @@ class BeaconHandler:
                 attrs={"round": round, "partials": len(partials),
                        "fused": True},
             ):
-                return await asyncio.to_thread(
+                return await self._offload(
                     self.scheme.finalize_round,
                     self.pub_poly, msg, list(partials.values()),
                     t, len(self.group),
@@ -487,14 +470,14 @@ class BeaconHandler:
                        "attempt": attempt},
             ):
                 try:
-                    return await asyncio.to_thread(
+                    return await self._offload(
                         self.scheme.finalize_round_optimistic,
                         self.pub_poly, msg, list(partials.values()),
                         t, len(self.group),
                     )
                 except tbls.ThresholdError:
                     _optimistic_fallbacks.inc()
-                    ok = await asyncio.to_thread(
+                    ok = await self._offload(
                         self.scheme.verify_partials_batch,
                         self.pub_poly, msg, list(partials.values()),
                     )
@@ -546,8 +529,9 @@ class BeaconHandler:
             # one short retry: a transient hiccup (peer mid-restart,
             # dropped stream) shouldn't cost the round this signer's
             # partial; a genuinely down peer is absorbed by the
-            # threshold exactly as before
-            await asyncio.sleep(GOSSIP_RETRY_DELAY)
+            # threshold exactly as before.  Clock-driven so simulated
+            # networks retry on the simulated timeline, not wall time.
+            await self.clock.sleep(GOSSIP_RETRY_DELAY)
             try:
                 await self.client.new_beacon(node, packet)
             except Exception as exc:
@@ -603,7 +587,7 @@ class BeaconHandler:
                                          packet.prev_round, packet.round)
                     # heavy pairing math runs off the event loop so the
                     # gRPC server keeps answering during verification
-                    await asyncio.to_thread(
+                    await self._offload(
                         self.scheme.verify_partial, self.pub_poly, msg,
                         packet.partial_sig,
                     )
@@ -660,7 +644,7 @@ class BeaconHandler:
         """
         peers = [n for n in (peers or self.group.nodes)
                  if n.address != self.cfg.public.address]
-        random.shuffle(peers)
+        self._rng.shuffle(peers)
         for peer in peers:
             try:
                 await self._sync_from(peer)
@@ -751,7 +735,7 @@ class BeaconHandler:
         sigs = [b.signature for b in batch]
         # mid-run resyncs share the event loop with live round intake:
         # the batched pairing check runs off-loop like process_beacon's
-        ok = await asyncio.to_thread(
+        ok = await self._offload(
             self.scheme.verify_chain_batch, self.dist_key, msgs, sigs
         )
         if not all(ok):

@@ -151,16 +151,17 @@ def cmd_start(args) -> int:
 
     _apply_compile_cache(args)
 
-    # post-mortem evidence next to the keys: an unhandled exception (and
-    # SIGTERM below) dumps the flight-recorder ring buffer before exit
-    dump_path = os.path.join(
-        os.path.expanduser(args.folder), "flight_dump.json"
-    )
-    install_crash_handler(dump_path)
-
     async def run():
         store = _store(args)
         pair = store.load_key_pair()
+        # post-mortem evidence next to the keys: an unhandled exception
+        # (and SIGTERM below) dumps the flight-recorder ring buffer
+        # before exit.  Named per node identity — in-process multi-node
+        # setups must not clobber one another's dump.
+        install_crash_handler(os.path.join(
+            os.path.expanduser(args.folder),
+            flight.dump_filename(pair.public.address),
+        ))
         tls_cert = tls_key = None
         if args.tls_cert or args.tls_key:
             if not (args.tls_cert and args.tls_key):
@@ -696,6 +697,52 @@ def cmd_doctor(args) -> int:
     return 1 if any(f["severity"] == "critical" for f in findings) else 0
 
 
+def cmd_sim_list(args) -> int:
+    """List the scripted chaos scenarios the simulator knows."""
+    from drand_tpu.sim import list_scenarios
+
+    for name, summary, expect_stall in list_scenarios():
+        tag = " [expects stall]" if expect_stall else ""
+        print(f"{name:16s} {summary}{tag}")
+    return 0
+
+
+def cmd_sim_run(args) -> int:
+    """Run one deterministic simulation scenario.
+
+    Same --scenario and --seed produce a byte-identical event log, so a
+    failing nightly seed replays exactly with this command.  Exit 0 when
+    the scenario's expectations hold (including scenarios that EXPECT a
+    stall, like fork_stall), 1 otherwise.
+    """
+    import json
+
+    from drand_tpu.sim import run_scenario
+
+    report = run_scenario(args.scenario, seed=args.seed,
+                          nodes=args.nodes, rounds=args.rounds)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report.event_log)
+    if args.json:
+        print(report.to_json())
+    else:
+        verdict = "PASSED" if report.passed else "FAILED"
+        print(f"{verdict} scenario={report.scenario} seed={report.seed}")
+        heads = " ".join(f"{a}={r}" for a, r in sorted(report.heads.items()))
+        print(f"  heads: {heads}")
+        print(f"  stalled: {report.stalled}  "
+              f"violations: {len(report.violations)}")
+        for v in report.violations:
+            print(f"  violation [{v['kind']}] node={v['node']} "
+                  f"round={v['round']}: {v['detail']}")
+        for f in report.failures:
+            print(f"  FAIL: {f}")
+        if args.out:
+            print(f"  event log: {args.out}")
+    return 0 if report.passed else 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="drand-tpu",
@@ -886,6 +933,34 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--json", action="store_true",
                    help="print findings as a JSON list")
     g.set_defaults(fn=cmd_doctor)
+
+    g = sub.add_parser(
+        "sim",
+        help="deterministic multi-node simulation (chaos scenarios)",
+    )
+    sim_sub = g.add_subparsers(dest="sim_cmd", required=True)
+
+    s = sim_sub.add_parser("list", help="list available scenarios")
+    s.set_defaults(fn=cmd_sim_list)
+
+    s = sim_sub.add_parser(
+        "run",
+        help="run a scenario; same --seed replays byte-identically",
+    )
+    s.add_argument("--scenario", required=True,
+                   help="scenario name (see `sim list`)")
+    s.add_argument("--seed", type=int, default=1,
+                   help="determinism seed (default 1)")
+    s.add_argument("--nodes", type=int,
+                   help="override node count (fixed-topology scenarios "
+                        "refuse this)")
+    s.add_argument("--rounds", type=int,
+                   help="override how many rounds to simulate")
+    s.add_argument("--out",
+                   help="write the replayable event log (JSON) here")
+    s.add_argument("--json", action="store_true",
+                   help="print the full report as JSON")
+    s.set_defaults(fn=cmd_sim_run)
     return p
 
 

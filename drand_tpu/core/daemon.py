@@ -21,7 +21,7 @@ import os
 import secrets
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set
 
 from drand_tpu.beacon import (
     Beacon,
@@ -98,6 +98,14 @@ class Config:
     #: or "eager" (pairing check per partial at arrival — the fallback
     #: knob if optimistic finalization misbehaves in the field)
     partial_verify: str = "optimistic"
+    #: outbound protocol transport; None = the gRPC client.  Injectable
+    #: (net/interface.ProtocolClient) so a simulated daemon talks over
+    #: an in-memory fabric instead of sockets.  Must also provide
+    #: `close()` and, for DKG flows, `send_dkg`/`dkg_context`.
+    protocol_client: Optional[object] = None
+    #: entropy source for private-randomness replies; injectable so
+    #: deterministic simulations never touch the OS CSPRNG
+    entropy_fn: "Callable[[int], bytes]" = secrets.token_bytes
 
 
 class Drand:
@@ -121,7 +129,7 @@ class Drand:
         self._beacon_store: Optional[BeaconStore] = None
         self.dkg: Optional[DKGHandler] = None
         self._dkg_group: Optional[Group] = None
-        self._client = GrpcClient(cfg.cert_manager)
+        self._client = cfg.protocol_client or GrpcClient(cfg.cert_manager)
         self._verify_gateway = None
         self._servers: List = []
         self._subscribers: Set[asyncio.Queue] = set()
@@ -286,16 +294,19 @@ class Drand:
 
     def _dump_flight(self) -> None:
         """Best-effort flight-recorder dump into the daemon folder, so a
-        crash or SIGTERM leaves post-mortem evidence next to the keys."""
+        crash or SIGTERM leaves post-mortem evidence next to the keys.
+        The filename carries this node's identity: several in-process
+        daemons (integration tests, the simulator) stopping at once must
+        not overwrite each other's dump."""
         if self.cfg.in_memory:
             return
         from drand_tpu.obs import flight
 
         try:
             base = os.path.expanduser(self.cfg.base_folder)
-            flight.RECORDER.dump_to(
-                os.path.join(base, "flight_dump.json")
-            )
+            flight.RECORDER.dump_to(os.path.join(
+                base, flight.dump_filename(self.pair.public.address)
+            ))
         except Exception as exc:
             log.debug("flight dump failed", err=exc)
 
@@ -566,7 +577,7 @@ class Drand:
         eph_pub = ref.g1_from_bytes(plain)
         if eph_pub is None:
             raise ValueError("identity ephemeral key")
-        return ecies.encrypt(eph_pub, secrets.token_bytes(32))
+        return ecies.encrypt(eph_pub, self.cfg.entropy_fn(32))
 
     def group_toml(self) -> Optional[str]:
         g = self.group or self._dkg_group

@@ -78,6 +78,28 @@ class _StdlibAEAD:
 
 _AEAD = AESGCM if AESGCM is not None else _StdlibAEAD
 
+_warned_fallback = False
+
+
+def _warn_fallback_once() -> None:
+    """One-time operator warning when the stdlib AEAD fallback is live:
+    its ciphertexts are NOT wire-compatible with AES-GCM, so a node
+    running it can only exchange private randomness / DKG deal shares
+    with peers on the same fallback.  Emitted at first use (the module
+    import happens long before anyone knows ECIES will be exercised)."""
+    global _warned_fallback
+    if _warned_fallback or AESGCM is not None:
+        return
+    _warned_fallback = True
+    from drand_tpu.utils.logging import get_logger
+
+    get_logger("ecies").warning(
+        "cryptography package unavailable: using the stdlib AEAD "
+        "fallback, which is NOT wire-compatible with AES-GCM — every "
+        "peer in the fleet must run the same fallback (install "
+        "'cryptography' everywhere for mixed deployments)"
+    )
+
 
 def _hkdf_sha256(ikm: bytes, length: int, info: bytes) -> bytes:
     """RFC 5869 HKDF-SHA256 (salt = zeros) via stdlib hmac — bit-exact
@@ -104,6 +126,7 @@ def _derive_key(shared_point) -> bytes:
 def encrypt(recipient_pub, plaintext: bytes,
             associated_data: bytes = b"") -> bytes:
     """Encrypt to a G1 public key."""
+    _warn_fallback_once()
     eph = rand_scalar()
     r_point = ref.g1_mul(ref.G1_GEN, eph)
     shared = ref.g1_mul(recipient_pub, eph)
@@ -116,6 +139,7 @@ def encrypt(recipient_pub, plaintext: bytes,
 def decrypt(private_scalar: int, blob: bytes,
             associated_data: bytes = b"") -> bytes:
     """Decrypt with the recipient's secret scalar."""
+    _warn_fallback_once()
     if len(blob) < 48 + NONCE_LEN + 16:
         raise EciesError("ciphertext too short")
     try:

@@ -26,6 +26,12 @@ class Group:
     genesis_time: int = 0          # unix seconds
     transition_time: int = 0       # unix seconds (resharing)
     genesis_seed: bytes = b""
+    #: per-objective SLO overrides ([[SLO]] tables in the group file;
+    #: keys validated by obs/slo.parse_overrides, applied
+    #: first-registration-wins by the beacon handler).  Operational
+    #: config only: deliberately EXCLUDED from the group hash so adding
+    #: an alerting tweak doesn't change the chain's identity.
+    slo: List[Dict] = field(default_factory=list)
 
     def __post_init__(self):
         n = len(self.nodes)
@@ -83,6 +89,8 @@ class Group:
         }
         if self.genesis_seed:
             d["GenesisSeed"] = self.genesis_seed.hex()
+        if self.slo:
+            d["SLO"] = [dict(e) for e in self.slo]
         return d
 
     @classmethod
@@ -95,6 +103,7 @@ class Group:
             transition_time=int(d.get("TransitionTime", 0)),
             genesis_seed=bytes.fromhex(d["GenesisSeed"])
             if d.get("GenesisSeed") else b"",
+            slo=[dict(e) for e in d.get("SLO", [])],
         )
 
 

@@ -2,12 +2,36 @@
 
 Equivalent of the reference's `net/` package: `Gateway` (public gRPC+REST
 listener), `ControlListener` (localhost control port), connection-cached
-clients, and the certificate manager (/root/reference/net/)."""
+clients, and the certificate manager (/root/reference/net/).
 
-from drand_tpu.net.transport import (  # noqa: F401
-    ControlClient,
-    GrpcClient,
-    build_control_server,
-    build_public_server,
-)
-from drand_tpu.net.tls import CertManager, generate_self_signed  # noqa: F401
+Attribute access is lazy (PEP 562): `net/transport.py` imports grpc and
+the generated protobufs, which the dependency-free consumers of
+`net/interface.py` (the beacon handler, the simulator) must not pay
+for — or cycle through, since transport itself imports the handler's
+packet types from the interface module.
+"""
+
+_LAZY = {
+    "ControlClient": "drand_tpu.net.transport",
+    "GrpcClient": "drand_tpu.net.transport",
+    "build_control_server": "drand_tpu.net.transport",
+    "build_public_server": "drand_tpu.net.transport",
+    "CertManager": "drand_tpu.net.tls",
+    "generate_self_signed": "drand_tpu.net.tls",
+    "BeaconPacket": "drand_tpu.net.interface",
+    "ProtocolClient": "drand_tpu.net.interface",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(target)
+    value = getattr(mod, name)
+    globals()[name] = value  # cache for the next access
+    return value

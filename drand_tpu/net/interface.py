@@ -1,0 +1,77 @@
+"""Protocol-plane transport interface (dependency-free).
+
+Extracted from `beacon/handler.py` so every transport — the gRPC client
+in `net/transport.py`, the loopback nets in tests, and the simulator's
+fault-injecting fabric (`drand_tpu/sim/fabric.py`) — implements one
+contract the beacon handler is written against.  This module must stay
+stdlib-only: the simulator imports it without dragging grpc in, and
+`net/__init__` lazy-loads the heavy transport module for the same
+reason.
+
+`BeaconPacket` is the wire content of a partial-signature broadcast
+(the NewBeacon RPC); `ProtocolClient` is the outbound half every node
+holds.  The gRPC servicers in `net/transport.py` are the inbound half
+and need no interface here — they call straight into the daemon facade.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, AsyncIterator
+
+if TYPE_CHECKING:  # only for signatures; no runtime import cost
+    from drand_tpu.beacon.chain import Beacon
+    from drand_tpu.key import Identity
+
+
+@dataclass
+class BeaconPacket:
+    """Wire content of a partial-signature broadcast (NewBeacon RPC)."""
+
+    from_address: str
+    round: int
+    prev_round: int
+    prev_sig: bytes
+    partial_sig: bytes
+    #: distributed-trace id of the round this partial belongs to; every
+    #: group member derives the same value, but carrying it on the wire
+    #: lets out-of-group observers stitch too (and survives seed drift)
+    trace_id: str = ""
+    #: sender's clock at send time (unix seconds; 0 = not carried) — the
+    #: receiver's peer ledger estimates clock skew from recv - sent_at
+    sent_at: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "from_address": self.from_address,
+            "round": self.round,
+            "prev_round": self.prev_round,
+            "prev_sig": self.prev_sig.hex(),
+            "partial_sig": self.partial_sig.hex(),
+            "trace_id": self.trace_id,
+            "sent_at": self.sent_at,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BeaconPacket":
+        return cls(
+            from_address=d["from_address"],
+            round=int(d["round"]),
+            prev_round=int(d["prev_round"]),
+            prev_sig=bytes.fromhex(d["prev_sig"]),
+            partial_sig=bytes.fromhex(d["partial_sig"]),
+            trace_id=d.get("trace_id", ""),
+            sent_at=float(d.get("sent_at", 0.0)),
+        )
+
+
+class ProtocolClient:
+    """Outbound protocol-plane transport (gRPC, loopback, or sim fabric)."""
+
+    async def new_beacon(self, peer: "Identity",
+                         packet: BeaconPacket) -> None:
+        raise NotImplementedError
+
+    def sync_chain(self, peer: "Identity",
+                   from_round: int) -> "AsyncIterator[Beacon]":
+        raise NotImplementedError

@@ -17,7 +17,7 @@ import grpc
 import grpc.aio
 
 from drand_tpu.beacon.chain import Beacon
-from drand_tpu.beacon.handler import BeaconPacket, ProtocolClient
+from drand_tpu.net.interface import BeaconPacket, ProtocolClient
 from drand_tpu.key import Identity
 from drand_tpu.net import dkg_codec
 from drand_tpu.net import drand_tpu_pb2 as pb

@@ -14,26 +14,37 @@ valid JSON even when concurrent writers are appending mid-serialise
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
+import re
 import sys
 import threading
 import time
 from collections import deque
 from typing import List, Optional
 
+#: distinguishes concurrent dump_to calls within one process — the pid
+#: alone collides when several in-process nodes dump at once
+_TMP_SEQ = itertools.count()
+
 
 class FlightRecorder:
-    """Fixed-capacity event ring; thread-safe, allocation-light."""
+    """Fixed-capacity event ring; thread-safe, allocation-light.
 
-    def __init__(self, capacity: int = 2048):
+    `now_fn` is injectable so a simulated network's recorder stamps
+    events with simulated time — a seeded replay then produces a
+    byte-identical dump, wall clock be damned."""
+
+    def __init__(self, capacity: int = 2048, now_fn=time.time):
         self.capacity = capacity
+        self._now_fn = now_fn
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=capacity)
         self._seq = 0
 
     def record(self, kind: str, **fields) -> None:
-        ev = {"seq": 0, "ts": time.time(), "kind": kind}
+        ev = {"seq": 0, "ts": self._now_fn(), "kind": kind}
         ev.update(fields)
         with self._lock:
             self._seq += 1
@@ -54,8 +65,11 @@ class FlightRecorder:
 
     def dump_to(self, path: str) -> None:
         """Atomic write (tmp + rename) so a crash mid-dump never leaves
-        a truncated file where the post-mortem evidence should be."""
-        tmp = f"{path}.tmp.{os.getpid()}"
+        a truncated file where the post-mortem evidence should be.  The
+        tmp name carries a process-unique sequence number on top of the
+        pid: in-process multi-node runs (tests, the simulator) dump
+        concurrently from ONE pid."""
+        tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_SEQ)}"
         with open(tmp, "w") as f:
             f.write(self.dump())
         os.replace(tmp, path)
@@ -71,6 +85,17 @@ class FlightRecorder:
 
 #: process-wide recorder (tracer sink + gateway + kernels feed it)
 RECORDER = FlightRecorder()
+
+
+def dump_filename(identity: str = "") -> str:
+    """Flight-dump filename, namespaced by node identity so in-process
+    multi-node runs (two daemons sharing a folder in tests, simulator
+    nodes) don't clobber each other's post-mortem evidence.  An empty
+    identity keeps the historical `flight_dump.json` name."""
+    if not identity:
+        return "flight_dump.json"
+    safe = re.sub(r"[^A-Za-z0-9._-]", "_", identity)
+    return f"flight_dump.{safe}.json"
 
 
 def install_crash_handler(path: str,

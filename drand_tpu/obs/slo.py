@@ -295,6 +295,100 @@ class SLOEngine:
             self._objectives.clear()
 
 
+#: keys accepted in a group-file [[SLO]] table (key/group.py round-trips
+#: them verbatim; anything else is a typo the operator must hear about)
+_OVERRIDE_KEYS = {
+    "Name", "Target", "ThresholdSeconds", "PeriodFraction",
+    "BudgetWindow", "BucketSeconds", "Describe",
+}
+
+
+def parse_overrides(entries, period: Optional[float] = None
+                    ) -> Dict[str, dict]:
+    """Validate group-file SLO overrides into `ENGINE.objective` kwargs.
+
+    `entries` is the group TOML's `[[SLO]]` array (list of dicts); the
+    returned mapping is objective name -> keyword arguments.  Because
+    `objective()` is first-registration-wins, a caller that registers
+    these BEFORE its built-in defaults makes the group file
+    authoritative.  Raises ValueError on any malformed entry — callers
+    (BeaconConfig) validate at configuration time, not mid-round.
+
+    Keys: `Name` (required), `Target` (good fraction in (0, 1]),
+    `ThresholdSeconds` OR `PeriodFraction` (latency bound, absolute or
+    as a fraction of the beacon period — the fraction form needs
+    `period`), `BudgetWindow` (duration string, e.g. "24h"),
+    `BucketSeconds`, `Describe`.
+    """
+    out: Dict[str, dict] = {}
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            raise ValueError(f"SLO override #{i}: expected a table")
+        unknown = sorted(set(entry) - _OVERRIDE_KEYS)
+        if unknown:
+            raise ValueError(
+                f"SLO override #{i}: unknown key(s) {unknown} "
+                f"(accepted: {sorted(_OVERRIDE_KEYS)})"
+            )
+        name = entry.get("Name")
+        if not name or not isinstance(name, str):
+            raise ValueError(f"SLO override #{i}: Name is required")
+        if name in out:
+            raise ValueError(f"SLO override {name!r} declared twice")
+        kw: dict = {}
+        if "Target" in entry:
+            target = float(entry["Target"])
+            if not 0.0 < target <= 1.0:
+                raise ValueError(
+                    f"SLO {name!r}: Target must be in (0, 1], "
+                    f"got {target}"
+                )
+            kw["target"] = target
+        if "ThresholdSeconds" in entry and "PeriodFraction" in entry:
+            raise ValueError(
+                f"SLO {name!r}: give ThresholdSeconds OR PeriodFraction,"
+                " not both"
+            )
+        if "ThresholdSeconds" in entry:
+            thr = float(entry["ThresholdSeconds"])
+            if thr <= 0:
+                raise ValueError(
+                    f"SLO {name!r}: ThresholdSeconds must be > 0"
+                )
+            kw["threshold"] = thr
+        if "PeriodFraction" in entry:
+            frac = float(entry["PeriodFraction"])
+            if frac <= 0:
+                raise ValueError(
+                    f"SLO {name!r}: PeriodFraction must be > 0"
+                )
+            if period is None:
+                raise ValueError(
+                    f"SLO {name!r}: PeriodFraction needs a beacon period"
+                )
+            kw["threshold"] = frac * period
+        if "BudgetWindow" in entry:
+            from drand_tpu.utils import parse_duration
+
+            window = parse_duration(entry["BudgetWindow"])
+            if window <= 0:
+                raise ValueError(
+                    f"SLO {name!r}: BudgetWindow must be > 0"
+                )
+            kw["budget_window"] = window
+        if "BucketSeconds" in entry:
+            bucket = float(entry["BucketSeconds"])
+            if bucket <= 0:
+                raise ValueError(
+                    f"SLO {name!r}: BucketSeconds must be > 0"
+                )
+            kw["bucket_seconds"] = bucket
+        if "Describe" in entry:
+            kw["describe"] = str(entry["Describe"])
+        out[name] = kw
+    return out
+
+
 def _events(slo: str, result: str):
     return metrics.counter(
         "drand_slo_events_total", "SLO events judged good or bad",

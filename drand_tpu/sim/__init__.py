@@ -1,0 +1,33 @@
+"""drand_tpu.sim — deterministic multi-node simulation harness.
+
+FoundationDB-style simulation testing for the beacon protocol: tens of
+nodes, one process, one event loop, one schedulable fake clock, a fake
+network fabric with scripted faults (partitions, latency, loss,
+Byzantine signers, device faults), protocol invariants checked at every
+round boundary, and byte-identical replay from a seed.
+
+Entry points:
+
+    from drand_tpu.sim import run_scenario, SCENARIOS
+    report = run_scenario("fork_stall", seed=7)
+
+or `drand-tpu sim run --scenario fork_stall --seed 7` from the CLI.
+"""
+
+from drand_tpu.sim.scenario import (
+    Scenario,
+    SimEvent,
+    SimReport,
+    run_scenario,
+)
+from drand_tpu.sim.scenarios import SCENARIOS, get_scenario, list_scenarios
+
+__all__ = [
+    "Scenario",
+    "SimEvent",
+    "SimReport",
+    "SCENARIOS",
+    "get_scenario",
+    "list_scenarios",
+    "run_scenario",
+]

@@ -1,0 +1,389 @@
+"""In-memory network fabric with scripted faults.
+
+The simulator's replacement for `net/transport.py`: every node holds a
+`FabricClient` (the `net/interface.ProtocolClient` contract) whose sends
+go through one shared `SimFabric`.  The fabric owns per-directed-link
+state — blocked flags (partitions, half-partitions), base latency,
+jitter, drop/duplicate probabilities, reorder spread — and delivers
+packets by scheduling callbacks on the shared simulated clock
+(`FakeClock.call_at`), so message arrival order is a pure function of
+the scenario seed.
+
+Determinism rules this module lives by:
+
+* every probabilistic decision draws from a per-directed-link
+  `random.Random` seeded from `(run seed, src, dst)` — link streams
+  never interleave, so adding chatter on one link cannot shift another
+  link's draws;
+* seeds are strings (hashed with sha512 inside `random.seed`), never
+  Python `hash()` — replays are byte-identical across processes
+  regardless of PYTHONHASHSEED;
+* timestamps come from the sim clock only.
+
+Byzantine signer strategies are outbound-client wrappers (`LiarClient`,
+`StaleHeadClient`, `EquivocatorClient`): the node's handler stays
+honest to itself while its wire traffic lies, which is exactly the
+adversary model — you can't trust what a peer *sends*, only what
+verifies.  `FaultScheme` wraps a real `Scheme` to inject device faults
+(a red recovered-signature check with every partial valid).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Dict, Optional, Set, Tuple
+
+from drand_tpu.beacon.chain import beacon_message
+from drand_tpu.crypto import tbls
+from drand_tpu.net.interface import BeaconPacket, ProtocolClient
+from drand_tpu.utils.clock import FakeClock
+
+
+class Link:
+    """State of one DIRECTED link (src -> dst)."""
+
+    __slots__ = ("latency", "jitter", "drop", "dup", "reorder",
+                 "reorder_spread", "blocked")
+
+    def __init__(self, latency: float = 0.01, jitter: float = 0.0,
+                 drop: float = 0.0, dup: float = 0.0,
+                 reorder: float = 0.0, reorder_spread: float = 0.5,
+                 blocked: bool = False):
+        self.latency = latency
+        self.jitter = jitter
+        self.drop = drop
+        self.dup = dup
+        self.reorder = reorder            # probability of extra delay
+        self.reorder_spread = reorder_spread  # max extra seconds
+        self.blocked = blocked
+
+    def configure(self, **kw) -> None:
+        for k, v in kw.items():
+            if k not in self.__slots__:
+                raise ValueError(f"unknown link property {k!r}")
+            setattr(self, k, v)
+
+
+class SimFabric:
+    """The one message bus every simulated node sends through."""
+
+    def __init__(self, clock: FakeClock, seed: int, recorder=None,
+                 default_link: Optional[dict] = None):
+        self.clock = clock
+        self.seed = seed
+        self.recorder = recorder
+        self.nodes: Dict[str, object] = {}       # addr -> SimNode
+        self._links: Dict[Tuple[str, str], Link] = {}
+        self._rngs: Dict[Tuple[str, str], random.Random] = {}
+        self._default_link = dict(default_link or {})
+        #: live ingest tasks — the settle loop drains these
+        self._tasks: Set[asyncio.Task] = set()
+
+    # -- topology ----------------------------------------------------------
+
+    def register(self, node) -> None:
+        self.nodes[node.address] = node
+
+    def link(self, src: str, dst: str) -> Link:
+        key = (src, dst)
+        ln = self._links.get(key)
+        if ln is None:
+            ln = self._links[key] = Link(**self._default_link)
+        return ln
+
+    def _rng(self, src: str, dst: str) -> random.Random:
+        key = (src, dst)
+        rng = self._rngs.get(key)
+        if rng is None:
+            # string seed -> sha512 path in random.seed: identical
+            # across processes, independent per directed link
+            rng = self._rngs[key] = random.Random(
+                f"drand-sim:{self.seed}:link:{src}->{dst}"
+            )
+        return rng
+
+    def set_links(self, src: Optional[str] = None,
+                  dst: Optional[str] = None, **kw) -> None:
+        """Configure link properties; None matches every node on that
+        side (src=None, dst=None configures the whole mesh, including
+        links not yet materialised — by touching all known pairs)."""
+        addrs = sorted(self.nodes)
+        for s in addrs if src is None else [src]:
+            for d in addrs if dst is None else [dst]:
+                if s != d:
+                    self.link(s, d).configure(**kw)
+
+    def block(self, src: str, dst: str) -> None:
+        self.link(src, dst).blocked = True
+
+    def unblock(self, src: str, dst: str) -> None:
+        self.link(src, dst).blocked = False
+
+    def deaf(self, addr: str) -> None:
+        """Half-partition: `addr` can send, cannot receive."""
+        for other in sorted(self.nodes):
+            if other != addr:
+                self.block(other, addr)
+
+    def undeaf(self, addr: str) -> None:
+        for other in sorted(self.nodes):
+            if other != addr:
+                self.unblock(other, addr)
+
+    def partition(self, *groups) -> None:
+        """Symmetric partition: links BETWEEN groups are blocked (links
+        within a group are left untouched)."""
+        sets = [set(g) for g in groups]
+        for i, a in enumerate(sets):
+            for b in sets[i + 1:]:
+                for x in sorted(a):
+                    for y in sorted(b):
+                        self.block(x, y)
+                        self.block(y, x)
+
+    def heal(self) -> None:
+        """Unblock every link (latency/drop settings survive)."""
+        for ln in self._links.values():
+            ln.blocked = False
+
+    def blocked(self, src: str, dst: str) -> bool:
+        return self.link(src, dst).blocked
+
+    # -- delivery ----------------------------------------------------------
+
+    def _log(self, kind: str, **fields) -> None:
+        if self.recorder is not None:
+            self.recorder.record(kind, **fields)
+
+    def _node_up(self, addr: str) -> bool:
+        node = self.nodes.get(addr)
+        return node is not None and node.up
+
+    async def send_beacon(self, src: str, dst: str,
+                          packet: BeaconPacket) -> None:
+        """Fire-and-forget partial broadcast: raises only when the
+        sender could KNOW the send failed (peer down / link blocked at
+        send time); loss in flight is silent, like UDP-flavored reality."""
+        if not self._node_up(dst):
+            raise ConnectionError(f"{dst} unreachable (down)")
+        if self.blocked(src, dst):
+            raise ConnectionError(f"{src}->{dst} partitioned")
+        link = self.link(src, dst)
+        rng = self._rng(src, dst)
+        if link.drop and rng.random() < link.drop:
+            self._log("net_drop", src=src, dst=dst, round=packet.round)
+            return
+        copies = 2 if (link.dup and rng.random() < link.dup) else 1
+        if copies == 2:
+            self._log("net_dup", src=src, dst=dst, round=packet.round)
+        for _ in range(copies):
+            delay = link.latency
+            if link.jitter:
+                delay += rng.random() * link.jitter
+            if link.reorder and rng.random() < link.reorder:
+                delay += rng.random() * link.reorder_spread
+            self.clock.call_at(self.clock.now() + delay,
+                               self._deliver, src, dst, packet)
+
+    def _deliver(self, src: str, dst: str, packet: BeaconPacket) -> None:
+        # delivery-time re-check: a partition that started after the
+        # send swallows in-flight messages too
+        if not self._node_up(dst) or self.blocked(src, dst):
+            self._log("net_lost", src=src, dst=dst, round=packet.round)
+            return
+        node = self.nodes[dst]
+        task = asyncio.ensure_future(self._ingest(node, packet))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _ingest(self, node, packet: BeaconPacket) -> None:
+        handler = node.handler
+        if handler is None:
+            return
+        try:
+            await handler.process_beacon(packet)
+        except Exception:
+            # window rejects / structural rejects are the handler's
+            # business; the fabric just moves bytes
+            pass
+
+    def active_tasks(self) -> int:
+        return len([t for t in self._tasks if not t.done()])
+
+    # -- chain sync --------------------------------------------------------
+
+    async def sync_stream(self, src: str, dst: str, from_round: int):
+        """Async generator for `sync_chain`: serves the peer's chain
+        snapshot with per-beacon stream latency; breaks (ConnectionError)
+        if either direction blocks or the peer dies mid-stream."""
+        if not self._node_up(dst):
+            raise ConnectionError(f"{dst} unreachable (down)")
+        if self.blocked(src, dst) or self.blocked(dst, src):
+            raise ConnectionError(f"sync {src}<->{dst} partitioned")
+        node = self.nodes[dst]
+        if node.handler is None:
+            raise ConnectionError(f"{dst} not serving")
+        link = self.link(dst, src)  # data flows dst -> src
+        for b in list(node.handler.sync_chain_from(from_round)):
+            await self.clock.sleep(link.latency)
+            if not self._node_up(dst) or self.blocked(dst, src) \
+                    or self.blocked(src, dst):
+                raise ConnectionError(f"sync stream {dst}->{src} broken")
+            yield b
+
+
+class FabricClient(ProtocolClient):
+    """One node's outbound transport over the shared fabric."""
+
+    def __init__(self, fabric: SimFabric, address: str):
+        self.fabric = fabric
+        self.address = address
+
+    async def new_beacon(self, peer, packet: BeaconPacket) -> None:
+        await self.fabric.send_beacon(self.address, peer.address, packet)
+
+    def sync_chain(self, peer, from_round: int):
+        return self.fabric.sync_stream(self.address, peer.address,
+                                       from_round)
+
+
+# -- Byzantine outbound strategies ----------------------------------------
+
+
+def _flip(b: bytes) -> bytes:
+    return (b[:-1] + bytes([b[-1] ^ 1])) if b else b"\x01"
+
+
+class LiarClient(ProtocolClient):
+    """Invalid-partial liar: every outgoing partial is a structurally
+    valid G2 point signed over the WRONG message (the chain link's
+    prev_sig with a flipped byte).  Receivers admit it optimistically;
+    the finalize blame pass must unmask it and charge THIS sender."""
+
+    def __init__(self, inner: ProtocolClient, scheme: tbls.Scheme, share):
+        self.inner = inner
+        self.scheme = scheme
+        self.share = share
+        self._cache: dict = {}  # round -> forged partial
+
+    def _forge(self, packet: BeaconPacket) -> bytes:
+        forged = self._cache.get(packet.round)
+        if forged is None:
+            bad_msg = beacon_message(_flip(packet.prev_sig),
+                                     packet.prev_round, packet.round)
+            forged = self.scheme.partial_sign(self.share, bad_msg)
+            self._cache = {packet.round: forged}  # keep exactly one round
+        return forged
+
+    async def new_beacon(self, peer, packet: BeaconPacket) -> None:
+        lie = BeaconPacket(
+            from_address=packet.from_address, round=packet.round,
+            prev_round=packet.prev_round, prev_sig=packet.prev_sig,
+            partial_sig=self._forge(packet), trace_id=packet.trace_id,
+            sent_at=packet.sent_at,
+        )
+        await self.inner.new_beacon(peer, lie)
+
+    def sync_chain(self, peer, from_round: int):
+        return self.inner.sync_chain(peer, from_round)
+
+
+class StaleHeadClient(ProtocolClient):
+    """Stale-head broadcaster: pins the first chain link it ever
+    gossips and keeps signing every later round against it.  Honest
+    receivers drop the partials on the link-mismatch check — the
+    threshold margin must absorb the dead weight."""
+
+    def __init__(self, inner: ProtocolClient, scheme: tbls.Scheme, share):
+        self.inner = inner
+        self.scheme = scheme
+        self.share = share
+        self._pinned = None  # (prev_round, prev_sig)
+        self._cache: dict = {}
+
+    async def new_beacon(self, peer, packet: BeaconPacket) -> None:
+        if self._pinned is None:
+            self._pinned = (packet.prev_round, packet.prev_sig)
+            await self.inner.new_beacon(peer, packet)
+            return
+        prev_round, prev_sig = self._pinned
+        forged = self._cache.get(packet.round)
+        if forged is None:
+            msg = beacon_message(prev_sig, prev_round, packet.round)
+            forged = self.scheme.partial_sign(self.share, msg)
+            self._cache = {packet.round: forged}
+        stale = BeaconPacket(
+            from_address=packet.from_address, round=packet.round,
+            prev_round=prev_round, prev_sig=prev_sig,
+            partial_sig=forged, trace_id=packet.trace_id,
+            sent_at=packet.sent_at,
+        )
+        await self.inner.new_beacon(peer, stale)
+
+    def sync_chain(self, peer, from_round: int):
+        return self.inner.sync_chain(peer, from_round)
+
+
+class EquivocatorClient(ProtocolClient):
+    """Equivocator: honest packets to the lexicographically-first half
+    of the peers, forged partials (LiarClient-style) to the rest — the
+    two halves see a different story from the same signer index."""
+
+    def __init__(self, inner: ProtocolClient, scheme: tbls.Scheme, share,
+                 peers):
+        self.inner = inner
+        self._liar = LiarClient(inner, scheme, share)
+        half = len(peers) // 2
+        self._honest_half = set(sorted(peers)[:half])
+
+    async def new_beacon(self, peer, packet: BeaconPacket) -> None:
+        if peer.address in self._honest_half:
+            await self.inner.new_beacon(peer, packet)
+        else:
+            await self._liar.new_beacon(peer, packet)
+
+    def sync_chain(self, peer, from_round: int):
+        return self.inner.sync_chain(peer, from_round)
+
+
+#: strategy name -> wrapper factory(inner, scheme, share, peer_addrs)
+BYZANTINE_STRATEGIES = {
+    "liar": lambda inner, scheme, share, peers:
+        LiarClient(inner, scheme, share),
+    "stale_head": lambda inner, scheme, share, peers:
+        StaleHeadClient(inner, scheme, share),
+    "equivocate": lambda inner, scheme, share, peers:
+        EquivocatorClient(inner, scheme, share, peers),
+}
+
+
+class FaultScheme:
+    """Scheme wrapper injecting device faults: while armed, the
+    recovered-signature check reports red even though every partial is
+    valid — the exact signature of a flaky accelerator.  The handler
+    must abandon the round gracefully (PR 5 regression contract), and
+    the chain must absorb the skipped round."""
+
+    def __init__(self, inner: tbls.Scheme):
+        self.inner = inner
+        self._armed = 0
+
+    def arm(self, count: int = 1) -> None:
+        self._armed += count
+
+    def _maybe_fault(self) -> None:
+        if self._armed > 0:
+            self._armed -= 1
+            raise tbls.ThresholdError("injected device fault")
+
+    def finalize_round_optimistic(self, *a, **kw):
+        self._maybe_fault()
+        return self.inner.finalize_round_optimistic(*a, **kw)
+
+    def finalize_round(self, *a, **kw):
+        self._maybe_fault()
+        return self.inner.finalize_round(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

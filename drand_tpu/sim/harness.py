@@ -1,0 +1,297 @@
+"""Deterministic multi-node simulation harness.
+
+Builds an n-node beacon network entirely in one process and one asyncio
+event loop: shares come from direct polynomial math (no DKG round-trip),
+transport is `sim.fabric.SimFabric`, and time is a single schedulable
+`FakeClock` that every node shares — each through its own `SkewedClock`
+lens so per-node clock skew is just a scenario parameter.
+
+Determinism contract (what makes `--seed N` byte-replayable):
+
+* heavy crypto runs through an INLINE offload instead of
+  `asyncio.to_thread`, so the whole network is cooperatively scheduled
+  on one thread — no OS scheduler in the loop;
+* every RNG is seeded from the scenario seed with string keys
+  (sha512-based, PYTHONHASHSEED-proof): one stream per directed link,
+  one per node incarnation, one for key generation;
+* the event log's timestamps come from the sim clock
+  (`FlightRecorder(now_fn=clock.now)`);
+* event-ordering code iterates sorted lists, never bare sets.
+
+Crash-restart keeps the node's `BeaconStore` object across the "process
+death" (it is the durable disk) and rebuilds handler + client from
+scratch with a bumped incarnation, exactly what a real restart does.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Dict, List, Optional
+
+from drand_tpu.beacon.handler import BeaconConfig, BeaconHandler
+from drand_tpu.beacon.store import BeaconStore
+from drand_tpu.crypto import refimpl as ref
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.poly import PriPoly
+from drand_tpu.key import Group, Pair, Share
+from drand_tpu.obs.flight import FlightRecorder
+from drand_tpu.sim.fabric import (
+    BYZANTINE_STRATEGIES,
+    FabricClient,
+    FaultScheme,
+    SimFabric,
+)
+from drand_tpu.utils.clock import FakeClock, SkewedClock
+
+#: sim nodes join at genesis with 10s of slack, like the tier-2 tests
+GENESIS_DELAY = 10
+
+
+async def _inline_offload(fn, *args, **kwargs):
+    """The simulator's replacement for asyncio.to_thread: run the
+    "heavy" call right here on the event loop.  Wall time stops
+    mattering (the sim clock is the only clock) and thread wake-up
+    nondeterminism disappears with the threads."""
+    return fn(*args, **kwargs)
+
+
+class SimNode:
+    """One simulated beacon node: keys, share, durable store, skewed
+    clock lens, fabric client (possibly wrapped by a Byzantine
+    strategy), and the live handler (None while crashed)."""
+
+    def __init__(self, index: int, pair: Pair, share: Share,
+                 world: "SimWorld", skew: float = 0.0,
+                 byzantine: Optional[str] = None):
+        self.index = index
+        self.pair = pair
+        self.share = share
+        self.world = world
+        self.address = pair.public.address
+        self.clock = SkewedClock(world.clock, skew)
+        self.store = BeaconStore()  # in-memory sqlite == the node's disk
+        self.byzantine = byzantine
+        self.fault_scheme = FaultScheme(world.scheme)
+        self.incarnation = 0
+        self.up = True
+        self.handler: Optional[BeaconHandler] = None
+
+    def _build_client(self):
+        client = FabricClient(self.world.fabric, self.address)
+        if self.byzantine:
+            peers = [n.address for n in self.world.group.nodes
+                     if n.address != self.address]
+            client = BYZANTINE_STRATEGIES[self.byzantine](
+                client, self.world.scheme, self.share.share, peers)
+        return client
+
+    def build_handler(self) -> BeaconHandler:
+        cfg = BeaconConfig(
+            group=self.world.group,
+            public=self.pair.public,
+            share=self.share,
+            scheme=self.fault_scheme,
+            clock=self.clock,
+            sync_batch=self.world.sync_batch,
+            offload=_inline_offload,
+            rng=random.Random(
+                f"drand-sim:{self.world.seed}:node:{self.address}"
+                f":{self.incarnation}"
+            ),
+        )
+        self.handler = BeaconHandler(cfg, self.store, self._build_client())
+        self.handler.add_callback(self._on_stored)
+        return self.handler
+
+    def _on_stored(self, beacon) -> None:
+        self.world.recorder.record(
+            "round_stored", node=self.address, round=beacon.round,
+            prev_round=beacon.prev_round,
+            sig=beacon.signature[:8].hex(),
+            incarnation=self.incarnation,
+        )
+
+    async def start(self) -> None:
+        self.build_handler()
+        await self.handler.start()
+
+    async def crash(self) -> None:
+        """Kill the process; the store (the disk) survives."""
+        if self.handler is not None:
+            await self.handler.stop()
+        self.handler = None
+        self.up = False
+        self.world.recorder.record("node_crash", node=self.address,
+                                   incarnation=self.incarnation)
+
+    async def restart(self) -> None:
+        """Come back as a fresh process over the surviving store."""
+        self.incarnation += 1
+        self.up = True
+        self.build_handler()
+        self.world.recorder.record("node_restart", node=self.address,
+                                   incarnation=self.incarnation)
+        await self.handler.catchup()
+
+
+class SimWorld:
+    """The whole simulated network plus its ground truth (the secret
+    polynomial) and the scenario event log."""
+
+    def __init__(self, n: int, threshold: int, period: float, seed: int,
+                 skews: Optional[Dict[int, float]] = None,
+                 byzantine: Optional[Dict[int, str]] = None,
+                 sync_batch: int = 64,
+                 default_link: Optional[dict] = None,
+                 scheme: Optional[tbls.Scheme] = None,
+                 start_time: float = 1_700_000_000.0):
+        self.seed = seed
+        self.n = n
+        self.sync_batch = sync_batch
+        self.clock = FakeClock(start=start_time)
+        self.recorder = FlightRecorder(capacity=1 << 16,
+                                       now_fn=self.clock.now)
+        self.fabric = SimFabric(self.clock, seed, recorder=self.recorder,
+                                default_link=default_link)
+        self.scheme = scheme or tbls._native_scheme_or_ref()
+
+        keyrng = random.Random(f"drand-sim:{seed}:keys")
+        pairs = [
+            Pair.generate(f"sim{i:02d}", rng=keyrng.randbytes)
+            for i in range(n)
+        ]
+        self.group = Group(
+            nodes=[p.public for p in pairs],
+            threshold=threshold,
+            period=period,
+            genesis_time=int(self.clock.now()) + GENESIS_DELAY,
+        )
+        self.poly = PriPoly.random(threshold, rng=keyrng.randbytes)
+        commits = self.poly.commit().commits
+        #: ground-truth distributed public key, straight from the secret
+        self.dist_key = ref.g1_mul(ref.G1_GEN, self.poly.secret())
+
+        byzantine = byzantine or {}
+        skews = skews or {}
+        self.nodes: List[SimNode] = []
+        for i, pair in enumerate(pairs):
+            node = SimNode(
+                i, pair,
+                Share(commits=commits, share=self.poly.eval(i)),
+                self, skew=skews.get(i, 0.0),
+                byzantine=byzantine.get(i),
+            )
+            self.fabric.register(node)
+            self.nodes.append(node)
+        #: addresses whose SIGNING behavior is honest (Byzantine wrappers
+        #: corrupt the wire, so their owners are excluded from the
+        #: cross-store and blame invariants)
+        self.honest = {n.address for n in self.nodes if not n.byzantine}
+        #: background scenario actions (a restarting node's catch-up
+        #: needs the clock to keep advancing, so it must not block the
+        #: runner that advances it)
+        self._bg: set = set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start_all(self) -> None:
+        for node in self.nodes:
+            await node.start()
+        self.recorder.record("sim_start", nodes=self.n,
+                             threshold=self.group.threshold,
+                             genesis=self.group.genesis_time,
+                             seed=self.seed)
+
+    async def stop_all(self) -> None:
+        for task in list(self._bg):
+            if not task.done():
+                task.cancel()
+        for node in self.nodes:
+            if node.handler is not None:
+                await node.handler.stop()
+        await self.settle()
+
+    def _spawn(self, coro, label: str) -> None:
+        task = asyncio.ensure_future(coro)
+        self._bg.add(task)
+
+        def _done(t, label=label):
+            self._bg.discard(t)
+            if t.cancelled():
+                return
+            exc = t.exception()
+            if exc is not None:
+                self.recorder.record("action_failed", action=label,
+                                     error=repr(exc))
+
+        task.add_done_callback(_done)
+
+    # -- time --------------------------------------------------------------
+
+    async def settle(self, max_spins: int = 500) -> None:
+        """Drain every zero-sim-time consequence: due clock callbacks,
+        fabric ingest tasks, and whatever they spawn, until the network
+        is quiescent at the current sim instant."""
+        for _ in range(max_spins):
+            self.clock.fire_due()
+            if self.fabric.active_tasks() == 0:
+                # a few clean yields: just-delivered partials may be
+                # waking round tasks that finalize + store inline
+                for _ in range(10):
+                    await asyncio.sleep(0)
+                if self.fabric.active_tasks() == 0 \
+                        and self.clock.fire_due() == 0:
+                    return
+            else:
+                await asyncio.sleep(0)
+
+    async def advance_to(self, when: float) -> None:
+        await self.clock.advance_to(when)
+        await self.settle()
+
+    # -- scenario actions --------------------------------------------------
+
+    def _addr(self, idx: int) -> str:
+        return self.nodes[idx].address
+
+    async def apply(self, action: str, args: dict) -> None:
+        """Execute one scenario fault event at the current sim time."""
+        self.recorder.record("fault_event", action=action,
+                             **{k: v for k, v in sorted(args.items())})
+        if action == "deaf":
+            self.fabric.deaf(self._addr(args["node"]))
+        elif action == "undeaf":
+            self.fabric.undeaf(self._addr(args["node"]))
+        elif action == "partition":
+            groups = [[self._addr(i) for i in g] for g in args["groups"]]
+            self.fabric.partition(*groups)
+        elif action == "heal":
+            self.fabric.heal()
+        elif action == "block":
+            self.fabric.block(self._addr(args["src"]),
+                              self._addr(args["dst"]))
+        elif action == "unblock":
+            self.fabric.unblock(self._addr(args["src"]),
+                                self._addr(args["dst"]))
+        elif action == "set_links":
+            kw = dict(args)
+            src = kw.pop("src", None)
+            dst = kw.pop("dst", None)
+            self.fabric.set_links(
+                None if src is None else self._addr(src),
+                None if dst is None else self._addr(dst), **kw)
+        elif action == "crash":
+            await self.nodes[args["node"]].crash()
+        elif action == "restart":
+            # runs in the background: catch-up sync sleeps on the sim
+            # clock, which only moves while the runner keeps advancing
+            self._spawn(self.nodes[args["node"]].restart(),
+                        f"restart:{args['node']}")
+        elif action == "skew":
+            self.nodes[args["node"]].clock.skew = args["seconds"]
+        elif action == "device_fault":
+            self.nodes[args["node"]].fault_scheme.arm(
+                args.get("count", 1))
+        else:
+            raise ValueError(f"unknown scenario action {action!r}")

@@ -1,0 +1,229 @@
+"""Protocol invariants checked over simulated nodes' state.
+
+The checks run at every round boundary and once more at the end of a
+scenario.  They look only at durable/observable state — the stores the
+nodes actually wrote, the contribution ledgers they actually keep, the
+doctor verdict over a status document a real `drand status` would show —
+never at simulator-internal bookkeeping, so a violation here is a
+protocol bug, not a harness artifact.
+
+Invariant catalogue (the `kind` on each Violation):
+
+* ``fork`` — two honest nodes disagree about history: either the same
+  round has two different beacons, or one node's chain *bridges over* a
+  round another honest node finalized (a gap between consecutive stored
+  beacons asserts "those rounds never happened"; an honest peer holding
+  one of them proves divergent chains).
+* ``chain_linkage`` — a single store's chain doesn't link: some beacon's
+  (prev_round, prev_sig) doesn't match the beacon stored before it.
+* ``chain_verify`` — a stored beacon's group signature fails pairing
+  verification against the distributed public key.
+* ``honest_blamed`` — an honest signer accrued invalid-partial charges
+  in some honest node's contribution ledger (the blame pass framed the
+  wrong peer).
+* ``byzantine_unblamed`` — checked only where a scenario demands it:
+  a lying signer whose forgeries reached quorum was never charged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List
+
+from drand_tpu.beacon.chain import beacon_message
+
+
+@dataclass
+class Violation:
+    kind: str
+    node: str
+    round: int
+    detail: str
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "node": self.node,
+                "round": self.round, "detail": self.detail}
+
+
+def _chain(store) -> List:
+    """The node's full stored chain, genesis first."""
+    return store.range_from(0)
+
+
+def check_linkage(addr: str, store) -> List[Violation]:
+    out: List[Violation] = []
+    chain = _chain(store)
+    for prev, b in zip(chain, chain[1:]):
+        if b.prev_round != prev.round or b.prev_sig != prev.signature:
+            out.append(Violation(
+                "chain_linkage", addr, b.round,
+                f"beacon {b.round} links prev_round={b.prev_round}, "
+                f"store predecessor is round {prev.round}",
+            ))
+    return out
+
+
+def check_forks(stores: Dict[str, object]) -> List[Violation]:
+    """Cross-node history agreement among HONEST nodes only."""
+    out: List[Violation] = []
+    chains = {addr: _chain(st) for addr, st in sorted(stores.items())}
+    by_round = {addr: {b.round: b for b in ch}
+                for addr, ch in chains.items()}
+    # (a) same round, different beacon
+    addrs = sorted(chains)
+    for i, a in enumerate(addrs):
+        for b_addr in addrs[i + 1:]:
+            common = sorted(set(by_round[a]) & set(by_round[b_addr]))
+            for r in common:
+                x, y = by_round[a][r], by_round[b_addr][r]
+                if (x.signature, x.prev_round, x.prev_sig) != \
+                        (y.signature, y.prev_round, y.prev_sig):
+                    out.append(Violation(
+                        "fork", a, r,
+                        f"round {r} differs between {a} and {b_addr}",
+                    ))
+    # (b) a finalized gap on one node covering a round another node has:
+    # consecutive stored beacons (p, b) with b.prev_round == p.round
+    # assert every round in (p.round, b.round) was skipped — an honest
+    # peer holding one of those rounds proves a forked chain
+    for a in addrs:
+        ch = chains[a]
+        for p, b in zip(ch, ch[1:]):
+            if b.round <= p.round + 1:
+                continue
+            for other in addrs:
+                if other == a:
+                    continue
+                for r in range(p.round + 1, b.round):
+                    if r in by_round[other]:
+                        out.append(Violation(
+                            "fork", a, r,
+                            f"{a}'s chain bridges over round {r} "
+                            f"({p.round}->{b.round}) but {other} "
+                            f"finalized it",
+                        ))
+    return out
+
+
+def check_chain_verifies(addr: str, store, scheme, dist_key,
+                         from_round: int = 1) -> List[Violation]:
+    """Every stored beacon's signature verifies against the distributed
+    key over the chained message (one batched pairing check per store
+    suffix).  The distributed key is derived straight from the secret
+    polynomial by the harness — ground truth the nodes never see."""
+    chain = store.range_from(max(1, from_round))
+    if not chain:
+        return []
+    msgs = [beacon_message(b.prev_sig, b.prev_round, b.round)
+            for b in chain]
+    sigs = [b.signature for b in chain]
+    ok = scheme.verify_chain_batch(dist_key, msgs, sigs)
+    return [
+        Violation("chain_verify", addr, b.round,
+                  "group signature fails pairing check")
+        for b, good in zip(chain, ok) if not good
+    ]
+
+
+def check_honest_unblamed(nodes: Iterable,
+                          honest: Iterable[str]) -> List[Violation]:
+    """No honest node's ledger charges an HONEST signer with invalid
+    partials.  Byzantine/faulty peers are allowed (expected, even) to
+    be charged."""
+    honest = set(honest)
+    out: List[Violation] = []
+    for node in nodes:
+        if node.handler is None or node.address not in honest:
+            continue
+        snap = node.handler.peer_ledger.snapshot(node.clock.now())
+        for peer_addr in sorted(snap):
+            st = snap[peer_addr]
+            if peer_addr in honest and st.get("invalid", 0):
+                out.append(Violation(
+                    "honest_blamed", node.address, -1,
+                    f"{node.address} charged honest {peer_addr} with "
+                    f"{st['invalid']} invalid partials",
+                ))
+    return out
+
+
+def check_byzantine_blamed(nodes: Iterable, honest: Iterable[str],
+                           liars: Iterable[str]) -> List[Violation]:
+    """Every liar whose forged partials reach honest quorums must be
+    charged by at least one honest ledger."""
+    honest = set(honest)
+    out: List[Violation] = []
+    for liar in sorted(set(liars)):
+        charged = False
+        for node in nodes:
+            if node.handler is None or node.address not in honest:
+                continue
+            snap = node.handler.peer_ledger.snapshot(node.clock.now())
+            if snap.get(liar, {}).get("invalid", 0):
+                charged = True
+                break
+        if not charged:
+            out.append(Violation(
+                "byzantine_unblamed", liar, -1,
+                f"liar {liar} was never charged an invalid partial "
+                f"by any honest node",
+            ))
+    return out
+
+
+@dataclass
+class InvariantState:
+    """Incremental across-checkpoint state: head samples for stall
+    detection plus the deduplicated violation log."""
+    scheme: object = None
+    dist_key: object = None
+    seen: set = field(default_factory=set)
+    violations: List[Violation] = field(default_factory=list)
+    head_samples: List[tuple] = field(default_factory=list)
+    verified_to: Dict[str, int] = field(default_factory=dict)
+
+    def _add(self, vs: List[Violation]) -> List[Violation]:
+        fresh = []
+        for v in vs:
+            key = (v.kind, v.node, v.round, v.detail)
+            if key not in self.seen:
+                self.seen.add(key)
+                self.violations.append(v)
+                fresh.append(v)
+        return fresh
+
+    def checkpoint(self, world, expected_round: int) -> List[Violation]:
+        """Run every per-checkpoint invariant; returns NEW violations."""
+        honest_nodes = [n for n in world.nodes
+                        if n.address in world.honest]
+        stores = {n.address: n.store for n in honest_nodes}
+        found: List[Violation] = []
+        for n in honest_nodes:
+            found.extend(check_linkage(n.address, n.store))
+            # verify only the suffix this node grew since last check —
+            # the pure-python pairing oracle is slow
+            frm = self.verified_to.get(n.address, 0) + 1
+            found.extend(check_chain_verifies(
+                n.address, n.store, self.scheme, self.dist_key,
+                from_round=frm))
+            head = n.store.last()
+            self.verified_to[n.address] = head.round if head else 0
+        found.extend(check_forks(stores))
+        found.extend(check_honest_unblamed(
+            [n for n in honest_nodes if n.up and n.handler is not None],
+            world.honest))
+        heads = [n.store.last().round if n.store.last() else 0
+                 for n in honest_nodes]
+        self.head_samples.append((expected_round, max(heads, default=0)))
+        return self._add(found)
+
+    def stalled(self, min_gap: int = 2) -> bool:
+        """The honest chain head stopped advancing while the scheduled
+        round kept marching: no head progress across the last three
+        checkpoints and the newest head at least `min_gap` rounds
+        behind schedule."""
+        s = self.head_samples
+        if len(s) < 3:
+            return False
+        (_, h0), (_, h1), (e2, h2) = s[-3], s[-2], s[-1]
+        return h0 == h1 == h2 and (e2 - h2) >= min_gap

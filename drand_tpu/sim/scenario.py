@@ -1,0 +1,273 @@
+"""Scenario DSL and the deterministic scenario runner.
+
+A `Scenario` is a declarative chaos script: network shape (n, threshold,
+period), a fault timeline (`SimEvent`s at offsets from genesis), static
+per-node attributes (clock skew, Byzantine strategy), and the
+expectations the run is judged against (converge vs. stall, which
+invariant violations are *supposed* to appear).  `run_scenario` executes
+it on `sim.harness.SimWorld`, checking `sim.invariants` at every round
+boundary, and returns a `SimReport` whose `event_log` is byte-identical
+for the same (scenario, seed) — the flight-recorder JSON is the replay
+artifact the acceptance gate diffs.
+
+The runner's timeline is a sorted list of stop points: every scheduled
+fault event plus one invariant checkpoint per round (at round-open +
+`settle_margin`, when all honest deliveries for the round have landed).
+Between stops the world advances in simulated time only — a fast-tier
+scenario with 10 nodes and 7 rounds never sleeps a wall-clock second.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field, replace
+from typing import Dict, FrozenSet, List, Optional
+
+from drand_tpu.beacon.chain import current_round
+from drand_tpu.sim.harness import SimWorld
+from drand_tpu.sim.invariants import (
+    InvariantState,
+    check_byzantine_blamed,
+)
+
+
+@dataclass
+class SimEvent:
+    """One scripted fault: `at` is seconds after genesis."""
+    at: float
+    action: str
+    args: dict = field(default_factory=dict)
+
+
+@dataclass
+class Scenario:
+    name: str
+    summary: str
+    n: int = 10
+    threshold: int = 7
+    period: float = 30.0
+    rounds: int = 6
+    events: List[SimEvent] = field(default_factory=list)
+    #: node index -> strategy name (sim.fabric.BYZANTINE_STRATEGIES)
+    byzantine: Dict[int, str] = field(default_factory=dict)
+    #: node index -> clock skew seconds (applied from the start)
+    skews: Dict[int, float] = field(default_factory=dict)
+    sync_batch: int = 64
+    #: base properties for every link (latency/jitter/drop/dup/reorder)
+    default_link: dict = field(default_factory=dict)
+    #: invariant checkpoint offset after each round opens; must exceed
+    #: worst-case delivery latency + |skew| so the round has settled
+    settle_margin: float = 15.0
+    #: the scenario is SUPPOSED to end stalled (doctor flags it)
+    expect_stall: bool = False
+    #: violation kinds that MUST appear (the scenario documents a bug)
+    require_violations: FrozenSet[str] = frozenset()
+    #: violation kinds tolerated in addition to the required ones
+    allow_violations: FrozenSet[str] = frozenset()
+    #: every lying Byzantine node must be charged invalid partials by
+    #: some honest ledger before the run ends
+    expect_blamed: bool = False
+    #: scenario scripts exact node indexes/links; --nodes is refused
+    fixed_topology: bool = False
+    notes: str = ""
+
+    def _max_scripted_index(self) -> int:
+        """Highest node index named anywhere in the script: static
+        byzantine/skew maps plus every event's node/src/dst/groups."""
+        hi = max(max(self.byzantine, default=-1),
+                 max(self.skews, default=-1))
+        for ev in self.events:
+            for key in ("node", "src", "dst"):
+                v = ev.args.get(key)
+                if isinstance(v, int):
+                    hi = max(hi, v)
+            for grp in ev.args.get("groups", []):
+                hi = max(hi, max(grp, default=-1))
+        return hi
+
+    def overridden(self, nodes: Optional[int] = None,
+                   rounds: Optional[int] = None) -> "Scenario":
+        """CLI-level overrides; scenarios with hand-built topologies
+        (fork_stall) set `fixed_topology` and refuse node overrides."""
+        scn = self
+        if nodes is not None and nodes != scn.n:
+            if scn.fixed_topology:
+                raise ValueError(
+                    f"scenario {scn.name} has a fixed topology of "
+                    f"{scn.n} nodes")
+            hi = scn._max_scripted_index()
+            if nodes <= hi:
+                raise ValueError(
+                    f"scenario {scn.name} scripts node indexes up to "
+                    f"{hi}; --nodes must exceed that")
+            scn = replace(scn, n=nodes,
+                          threshold=max(2, (2 * nodes) // 3))
+        if rounds is not None and rounds != scn.rounds:
+            scn = replace(scn, rounds=rounds)
+        return scn
+
+
+@dataclass
+class SimReport:
+    scenario: str
+    seed: int
+    passed: bool
+    failures: List[str]
+    violations: List[dict]
+    stalled: bool
+    heads: Dict[str, int]
+    doctor: Dict[str, list]
+    event_log: str
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        # the event log is a document of its own, not a summary field
+        d.pop("event_log")
+        return d
+
+    def to_json(self, indent: Optional[int] = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
+
+
+def _node_status(node, genesis: int, period: float) -> dict:
+    """Synthesize the status document `drand-tpu doctor` would fetch
+    from this node, from the node's own (possibly skewed) viewpoint."""
+    now = node.clock.now()
+    head = node.store.last()
+    handler = node.handler
+    return {
+        "chain": {
+            "head_round": head.round if head else 0,
+            "expected_round": current_round(now, period, genesis),
+            "running": bool(handler is not None
+                            and getattr(handler, "_running", False)),
+        },
+        "suspects": (handler.peer_ledger.suspects(now)
+                     if handler is not None else []),
+    }
+
+
+async def _run(scn: Scenario, seed: int) -> SimReport:
+    world = SimWorld(
+        n=scn.n, threshold=scn.threshold, period=scn.period, seed=seed,
+        skews=scn.skews, byzantine=scn.byzantine,
+        sync_batch=scn.sync_batch, default_link=scn.default_link,
+    )
+    inv = InvariantState(scheme=world.scheme, dist_key=world.dist_key)
+    await world.start_all()
+    genesis = world.group.genesis_time
+    period = world.group.period
+
+    # the timeline: fault events + one checkpoint per round, in time
+    # order; at equal times fault events apply before the checkpoint
+    stops = [(genesis + ev.at, 0, i, ("event", ev))
+             for i, ev in enumerate(scn.events)]
+    stops += [(genesis + (k - 1) * period + scn.settle_margin, 1, k,
+               ("checkpoint", k))
+              for k in range(1, scn.rounds + 1)]
+    stops.sort(key=lambda s: (s[0], s[1], s[2]))
+
+    for when, _, _, (kind, payload) in stops:
+        await world.advance_to(when)
+        if kind == "event":
+            await world.apply(payload.action, payload.args)
+            await world.settle()
+        else:
+            fresh = inv.checkpoint(world, expected_round=payload)
+            heads = sorted(
+                (n.address, n.store.last().round if n.store.last() else 0)
+                for n in world.nodes if n.address in world.honest)
+            world.recorder.record(
+                "invariant_check", round=payload,
+                new_violations=len(fresh), heads=dict(heads))
+
+    stalled = inv.stalled()
+
+    # doctor verdicts over synthesized status documents (sim nodes have
+    # no HTTP plane; `diagnose` is pure over the same shape)
+    from drand_tpu.cli import diagnose
+    doctor: Dict[str, list] = {}
+    for node in world.nodes:
+        if node.address not in world.honest or not node.up:
+            continue
+        doctor[node.address] = diagnose(
+            _node_status(node, genesis, period), {}, [])
+    stall_flagged = sorted(
+        addr for addr, findings in doctor.items()
+        if any(f["kind"] == "stalled_chain"
+               and f["severity"] == "critical" for f in findings))
+
+    failures: List[str] = []
+    kinds = {v.kind for v in inv.violations}
+    missing = set(scn.require_violations) - kinds
+    if missing:
+        failures.append(
+            f"required violations never occurred: {sorted(missing)}")
+    unexpected = kinds - set(scn.require_violations) \
+        - set(scn.allow_violations)
+    if unexpected:
+        failures.append(
+            f"unexpected invariant violations: {sorted(unexpected)}")
+
+    if scn.expect_stall:
+        if not stalled:
+            failures.append("expected the chain to stall; it advanced")
+        if not stall_flagged:
+            failures.append("doctor never flagged stalled_chain on any "
+                            "honest node")
+    else:
+        if stalled:
+            failures.append("chain stalled unexpectedly")
+        for node in world.nodes:
+            if node.address not in world.honest or not node.up:
+                continue
+            head = node.store.last()
+            head_round = head.round if head else 0
+            if head_round < scn.rounds - 1:
+                failures.append(
+                    f"{node.address} did not converge: head "
+                    f"{head_round} < {scn.rounds - 1}")
+
+    if scn.expect_blamed:
+        liars = [world.nodes[i].address
+                 for i, strat in sorted(scn.byzantine.items())
+                 if strat in ("liar", "equivocate")]
+        for v in check_byzantine_blamed(world.nodes, world.honest,
+                                        liars):
+            failures.append(v.detail)
+
+    heads = {n.address: (n.store.last().round if n.store.last() else 0)
+             for n in world.nodes}
+    world.recorder.record(
+        "sim_end", stalled=stalled,
+        stall_flagged=stall_flagged,
+        violations=[v.to_dict() for v in inv.violations],
+        heads={a: heads[a] for a in sorted(heads)},
+        failures=list(failures),
+    )
+    await world.stop_all()
+
+    return SimReport(
+        scenario=scn.name, seed=seed, passed=not failures,
+        failures=failures,
+        violations=[v.to_dict() for v in inv.violations],
+        stalled=stalled, heads=heads, doctor=doctor,
+        event_log=world.recorder.dump(),
+    )
+
+
+def run_scenario(scenario, seed: int = 1,
+                 nodes: Optional[int] = None,
+                 rounds: Optional[int] = None) -> SimReport:
+    """Run a scenario (by name or `Scenario` object) to completion.
+
+    Same (scenario, seed) -> byte-identical `SimReport.event_log`,
+    across processes and PYTHONHASHSEED values.
+    """
+    import asyncio
+
+    if isinstance(scenario, str):
+        from drand_tpu.sim.scenarios import get_scenario
+        scenario = get_scenario(scenario)
+    scenario = scenario.overridden(nodes=nodes, rounds=rounds)
+    return asyncio.run(_run(scenario, seed))

@@ -1,0 +1,51 @@
+"""Scripted chaos scenarios.
+
+Each module exposes `build() -> Scenario`; this package is the registry
+the CLI (`drand-tpu sim list / sim run`) and the test suite enumerate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from drand_tpu.sim.scenario import Scenario
+
+from drand_tpu.sim.scenarios import (  # noqa: E402
+    asym_link,
+    byz_equivocate,
+    byz_liar,
+    byz_stale,
+    clock_skew,
+    crash_restart,
+    device_fault,
+    fork_stall,
+    lossy_link,
+    partition,
+)
+
+_MODULES = (
+    partition, asym_link, clock_skew, crash_restart, byz_liar,
+    byz_stale, byz_equivocate, device_fault, lossy_link, fork_stall,
+)
+
+SCENARIOS: Dict[str, object] = {m.build().name: m.build for m in _MODULES}
+
+
+def get_scenario(name: str) -> Scenario:
+    try:
+        factory = SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r}; available: "
+            f"{', '.join(sorted(SCENARIOS))}"
+        ) from None
+    return factory()
+
+
+def list_scenarios():
+    """(name, summary, expect_stall) rows, sorted by name."""
+    rows = []
+    for name in sorted(SCENARIOS):
+        scn = SCENARIOS[name]()
+        rows.append((scn.name, scn.summary, scn.expect_stall))
+    return rows

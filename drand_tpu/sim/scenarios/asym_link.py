@@ -1,0 +1,32 @@
+"""Asymmetric link faults: one mute node and one slow direction.
+
+Node 9 is muted (its outbound links block; inbound stays open) for two
+rounds — it keeps finalizing from everyone else's partials while the
+network tolerates its silence.  On top, the 0->1 direction runs at 3s
+latency the whole time, so node 1 always hears node 0 a beat late.
+Pure liveness noise: every invariant must hold and everyone converges.
+"""
+
+from drand_tpu.sim.scenario import Scenario, SimEvent
+
+
+def _mute(node, others, on):
+    action = "block" if on else "unblock"
+    return [SimEvent(at=35.0 if on else 95.0, action=action,
+                     args={"src": node, "dst": o}) for o in others]
+
+
+def build() -> Scenario:
+    others = [i for i in range(10) if i != 9]
+    return Scenario(
+        name="asym_link",
+        summary="node 9 muted (outbound blocked, inbound open) for two "
+                "rounds; 0->1 direction 3s slow throughout",
+        n=10, threshold=7, rounds=7,
+        events=[
+            SimEvent(at=-5.0, action="set_links",
+                     args={"src": 0, "dst": 1, "latency": 3.0}),
+            *_mute(9, others, on=True),
+            *_mute(9, others, on=False),
+        ],
+    )

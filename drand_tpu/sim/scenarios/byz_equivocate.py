@@ -1,0 +1,26 @@
+"""Byzantine equivocator.
+
+Node 2 tells the lexicographically-first half of its peers the truth
+and sends the rest structurally-valid forgeries — the classic
+split-view attack.  The lied-to half must unmask the forgeries at
+finalize and charge node 2; the truthfully-served half keeps counting
+its partials.  Both halves still finalize identical rounds: the chain,
+not the gossip, is the source of truth.
+"""
+
+from drand_tpu.sim.scenario import Scenario, SimEvent
+
+
+def build() -> Scenario:
+    return Scenario(
+        name="byz_equivocate",
+        summary="node 2 sends honest partials to half the peers and "
+                "forged ones to the rest; lied-to half must blame it",
+        n=10, threshold=7, rounds=6,
+        byzantine={2: "equivocate"},
+        events=[
+            SimEvent(at=-5.0, action="set_links",
+                     args={"src": 2, "latency": 0.001}),
+        ],
+        expect_blamed=True,
+    )

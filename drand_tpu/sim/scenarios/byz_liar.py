@@ -1,0 +1,26 @@
+"""Byzantine invalid-partial liar.
+
+Node 3 signs every wire partial over a corrupted message — structurally
+valid, cryptographically garbage.  Its outbound links are near-instant
+so the forgery is always inside the first-t optimistic quorum: every
+honest finalize must go red, fall back to the batched blame pass,
+charge the LIAR's address (never an honest signer), evict, refill, and
+still produce the round on time from the 9 honest signers.
+"""
+
+from drand_tpu.sim.scenario import Scenario, SimEvent
+
+
+def build() -> Scenario:
+    return Scenario(
+        name="byz_liar",
+        summary="node 3 broadcasts structurally-valid forged partials "
+                "from a fast link; blame pass must charge it every round",
+        n=10, threshold=7, rounds=6,
+        byzantine={3: "liar"},
+        events=[
+            SimEvent(at=-5.0, action="set_links",
+                     args={"src": 3, "latency": 0.001}),
+        ],
+        expect_blamed=True,
+    )

@@ -1,0 +1,21 @@
+"""Byzantine stale-head broadcaster.
+
+Node 6 pins the first chain link it ever gossips and keeps re-signing
+every later round against it.  Honest receivers drop the partials on
+the chain-link mismatch check — dead weight the 9-honest-of-10 margin
+absorbs.  The staler is never CHARGED (a mismatched link is a desync
+symptom, not proof of forgery) but its missed rounds pile up in every
+honest contribution ledger.
+"""
+
+from drand_tpu.sim.scenario import Scenario
+
+
+def build() -> Scenario:
+    return Scenario(
+        name="byz_stale",
+        summary="node 6 re-broadcasts partials signed against a pinned "
+                "stale chain link; link-mismatch drops absorb it",
+        n=10, threshold=7, rounds=6,
+        byzantine={6: "stale_head"},
+    )

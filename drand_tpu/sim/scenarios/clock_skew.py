@@ -1,0 +1,24 @@
+"""Per-node clock skew within protocol tolerance.
+
+Node 1 runs 3s fast, node 2 runs 3s slow (both well under the one-round
+packet window).  Fast tickers sign early — receivers must buffer the
+future-round partials in the look-ahead cache; slow tickers sign late —
+their partials still land inside the round.  Everything converges and
+no invariant fires.  Mid-run, node 3 drifts +4s via a scenario event.
+"""
+
+from drand_tpu.sim.scenario import Scenario, SimEvent
+
+
+def build() -> Scenario:
+    return Scenario(
+        name="clock_skew",
+        summary="nodes skewed +3s/-3s from genesis, one more drifts "
+                "+4s mid-run; look-ahead absorbs early signers",
+        n=10, threshold=7, rounds=7,
+        skews={1: 3.0, 2: -3.0},
+        events=[
+            SimEvent(at=65.0, action="skew",
+                     args={"node": 3, "seconds": 4.0}),
+        ],
+    )

@@ -1,0 +1,25 @@
+"""Crash mid-round, restart from the surviving store.
+
+Links run at 2s latency so partial collection for a round takes real
+(simulated) seconds — node 4 is killed one second into round 3's
+collection, with its own partial signed and in flight.  The store (its
+disk) survives; 34 seconds later the node restarts, replays catch-up
+from the store head, and rejoins as a full signer.  The network never
+drops below threshold (9 >= 7) and everyone converges.
+"""
+
+from drand_tpu.sim.scenario import Scenario, SimEvent
+
+
+def build() -> Scenario:
+    return Scenario(
+        name="crash_restart",
+        summary="node 4 killed mid-round-3 collection, restarted 34s "
+                "later from its surviving store; rejoins via catch-up",
+        n=10, threshold=7, rounds=7,
+        default_link={"latency": 2.0},
+        events=[
+            SimEvent(at=61.0, action="crash", args={"node": 4}),
+            SimEvent(at=95.0, action="restart", args={"node": 4}),
+        ],
+    )

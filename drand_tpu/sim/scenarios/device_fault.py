@@ -1,0 +1,25 @@
+"""Injected device fault at finalize.
+
+Node 5's scheme is armed to fail its next finalize with a red
+recovered-signature check even though every partial in the quorum is
+valid — the signature of a flaky accelerator, not a Byzantine peer.
+The handler must abandon the round gracefully (the PR-5 regression
+contract), charge NOBODY, and let the node rejoin via catch-up while
+the other nine finalize the round on schedule.
+"""
+
+from drand_tpu.sim.scenario import Scenario, SimEvent
+
+
+def build() -> Scenario:
+    return Scenario(
+        name="device_fault",
+        summary="node 5's accelerator fails one finalize (red check, "
+                "all partials valid); round abandoned gracefully, "
+                "nobody blamed",
+        n=10, threshold=7, rounds=7,
+        events=[
+            SimEvent(at=58.0, action="device_fault",
+                     args={"node": 5, "count": 1}),
+        ],
+    )

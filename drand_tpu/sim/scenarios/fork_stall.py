@@ -1,0 +1,51 @@
+"""The known half-partition fork stall (ROADMAP direction 1).
+
+This scenario REPRODUCES A REAL BUG on purpose.  It is the acceptance
+gate for the future fork-resolution PR: today it passes by expecting
+the fork; when fork resolution lands, flip `expect_stall` to False and
+empty the violation sets — the scenario then demands convergence.
+
+The mechanism, on a 3-node t=2 group (A=node 0, B=node 1, C=node 2):
+
+1. B goes deaf (inbound blocked, outbound open) after round 3.  A and C
+   keep finalizing rounds 4-5; B's head freezes at 3 while its ticker
+   keeps broadcasting stale-linked partials nobody accepts.
+2. Just before round 6 the fault flips: B heals, C goes deaf.  Round 6:
+   A and C sign against head 5; C's partial reaches A -> A finalizes 6.
+   B, seeing round-6 partials ahead of its head, catch-up syncs from A —
+   but the sync snapshot was taken BEFORE A stored 6, so B lands on
+   head 5.  C, deaf, is stuck at 5 too.
+3. Round 7: A signs against 6; B and C both sign against 5 — B's round
+   manager pins the stale link, C's matching stale partial arrives, and
+   t=2 is met: **B finalizes a forked round 7 with prev_round=5**,
+   even though round 6 exists.
+4. Nobody shares a chain link anymore.  A rejects B's fork during sync
+   ("chain link broken"), B and C can't help each other, and the group
+   stalls permanently: the doctor flags `stalled_chain` on every honest
+   node, yet no peer ledger charges anyone — every signer was honest.
+
+The run is judged PASSED when the stall occurs, the doctor flags it,
+the fork-class invariant fires, and no honest node is blamed.
+"""
+
+from drand_tpu.sim.scenario import Scenario, SimEvent
+
+
+def build() -> Scenario:
+    return Scenario(
+        name="fork_stall",
+        summary="half-partition flip makes a mid-catch-up node finalize "
+                "a forked round; permanent stall (known bug, gates the "
+                "fork-resolution PR)",
+        n=3, threshold=2, rounds=9,
+        fixed_topology=True,
+        events=[
+            SimEvent(at=65.0, action="deaf", args={"node": 1}),
+            SimEvent(at=125.0, action="undeaf", args={"node": 1}),
+            SimEvent(at=125.0, action="deaf", args={"node": 2}),
+        ],
+        expect_stall=True,
+        require_violations=frozenset({"chain_linkage"}),
+        allow_violations=frozenset({"chain_linkage", "fork"}),
+        notes="flip expect_stall/violations when fork resolution lands",
+    )

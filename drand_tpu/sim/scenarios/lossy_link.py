@@ -1,0 +1,20 @@
+"""Lossy, duplicating, reordering mesh.
+
+Every link drops 5% of packets silently, duplicates 15%, and delays a
+further 30% by up to half a second — UDP weather.  Signer dedup must
+absorb the duplicates, the look-ahead buffer the reordering, and the
+t=7-of-10 margin the drops.  All invariants hold; everyone converges.
+"""
+
+from drand_tpu.sim.scenario import Scenario
+
+
+def build() -> Scenario:
+    return Scenario(
+        name="lossy_link",
+        summary="5% drop / 15% duplicate / 30% reorder on every link; "
+                "dedup and threshold margin absorb the weather",
+        n=10, threshold=7, rounds=7,
+        default_link={"latency": 0.01, "jitter": 0.05,
+                      "drop": 0.05, "dup": 0.15, "reorder": 0.3},
+    )

@@ -1,0 +1,23 @@
+"""Symmetric partition: a 3-node minority is cut off for two rounds.
+
+The 7-node majority side still meets the threshold (t=7) and keeps the
+chain moving; the minority stalls, then pulls the missed segment via
+catch-up sync after the heal.  No invariant may fire: partitions must
+cost liveness on the small side only, never safety.
+"""
+
+from drand_tpu.sim.scenario import Scenario, SimEvent
+
+
+def build() -> Scenario:
+    return Scenario(
+        name="partition",
+        summary="3-of-10 minority partitioned for two rounds, then "
+                "healed; majority keeps finalizing, minority catches up",
+        n=10, threshold=7, rounds=7,
+        events=[
+            SimEvent(at=35.0, action="partition",
+                     args={"groups": [[0, 1, 2, 3, 4, 5, 6], [7, 8, 9]]}),
+            SimEvent(at=95.0, action="heal", args={}),
+        ],
+    )

@@ -6,6 +6,16 @@ core.Config.clock core/config.go:37) so multi-node protocol tests can
 drive rounds without wall time.  This is the asyncio equivalent: awaiting
 `clock.sleep(dt)` on a FakeClock parks the task until a test calls
 `advance(dt)`.
+
+The simulation harness (drand_tpu/sim/) extends the same clock into a
+schedulable event loop: `call_at` registers plain callbacks (the fake
+network fabric uses them for message-delivery deadlines) and `advance`
+interleaves scheduled callbacks with sleeping tasks in strict deadline
+order, so an entire multi-node network runs on one deterministic
+timeline.  `SkewedClock` wraps a base clock with a per-node offset —
+`now()` lies by `skew` seconds while `sleep` still parks on the shared
+timeline — which is how the simulator gives each node its own (wrong)
+notion of time without forking the timeline itself.
 """
 
 from __future__ import annotations
@@ -13,7 +23,7 @@ from __future__ import annotations
 import asyncio
 import heapq
 import time
-from typing import List, Tuple
+from typing import Callable, List, Tuple
 
 
 class Clock:
@@ -31,11 +41,18 @@ class FakeClock(Clock):
 
     `advance(dt)` moves time forward and wakes every sleeper whose
     deadline has passed, yielding control so woken tasks run promptly.
+    Scheduled callbacks (`call_at`) share the same deadline ordering:
+    ties break by registration order (a monotonically increasing
+    sequence number), never by object identity — replays stay
+    byte-identical across processes.
     """
 
     def __init__(self, start: float = 1_700_000_000.0):
         self._now = start
         self._sleepers: List[Tuple[float, int, asyncio.Future]] = []
+        #: (deadline, seq, callback, args) — callbacks run synchronously
+        #: at their deadline, before any later sleeper wakes
+        self._scheduled: List[Tuple[float, int, Callable, tuple]] = []
         self._seq = 0
 
     def now(self) -> float:
@@ -50,14 +67,59 @@ class FakeClock(Clock):
         heapq.heappush(self._sleepers, (self._now + seconds, self._seq, fut))
         await fut
 
+    # -- scheduled callbacks (sim fabric) ---------------------------------
+
+    def call_at(self, when: float, callback: Callable, *args) -> None:
+        """Run `callback(*args)` when the clock reaches `when` (clamped to
+        now: the past is not a place this clock can deliver to)."""
+        self._seq += 1
+        heapq.heappush(
+            self._scheduled, (max(when, self._now), self._seq, callback, args)
+        )
+
+    def fire_due(self) -> int:
+        """Run every scheduled callback whose deadline has arrived.
+        Returns how many fired (callbacks may schedule more; those run
+        too if already due)."""
+        fired = 0
+        while self._scheduled and self._scheduled[0][0] <= self._now:
+            _, _, cb, args = heapq.heappop(self._scheduled)
+            cb(*args)
+            fired += 1
+        return fired
+
+    def _next_deadline(self) -> float:
+        """Earliest pending deadline across sleepers and callbacks."""
+        deadlines = []
+        if self._sleepers:
+            deadlines.append(self._sleepers[0][0])
+        if self._scheduled:
+            deadlines.append(self._scheduled[0][0])
+        return min(deadlines) if deadlines else float("inf")
+
     async def advance(self, seconds: float) -> None:
-        """Move time forward, waking sleepers in deadline order."""
+        """Move time forward, firing callbacks and waking sleepers in
+        strict deadline order (registration order breaks ties between a
+        callback and a sleeper at the same instant)."""
         target = self._now + seconds
-        while self._sleepers and self._sleepers[0][0] <= target:
-            deadline, _, fut = heapq.heappop(self._sleepers)
-            self._now = max(self._now, deadline)
-            if not fut.done():
-                fut.set_result(None)
+        while True:
+            nxt = self._next_deadline()
+            if nxt > target:
+                break
+            self._now = max(self._now, nxt)
+            # same-deadline entries: lower seq goes first across BOTH heaps
+            take_sleeper = bool(self._sleepers) and \
+                self._sleepers[0][0] <= self._now and \
+                (not self._scheduled
+                 or self._scheduled[0][0] > self._now
+                 or self._sleepers[0][1] < self._scheduled[0][1])
+            if take_sleeper:
+                _, _, fut = heapq.heappop(self._sleepers)
+                if not fut.done():
+                    fut.set_result(None)
+            else:
+                _, _, cb, args = heapq.heappop(self._scheduled)
+                cb(*args)
             # let woken tasks (and anything they spawn) run
             for _ in range(10):
                 await asyncio.sleep(0)
@@ -65,5 +127,32 @@ class FakeClock(Clock):
         for _ in range(10):
             await asyncio.sleep(0)
 
+    async def advance_to(self, when: float) -> None:
+        """Advance to an absolute time (no-op if already past it)."""
+        if when > self._now:
+            await self.advance(when - self._now)
+
     def pending_sleepers(self) -> int:
         return len([s for s in self._sleepers if not s[2].done()])
+
+    def pending_callbacks(self) -> int:
+        return len(self._scheduled)
+
+
+class SkewedClock(Clock):
+    """A per-node view of a shared base clock, offset by `skew` seconds.
+
+    `now()` reports the skewed time (the node *believes* it); `sleep`
+    parks on the base clock's timeline, because a wrong wall clock does
+    not make real durations pass faster.  The skew is mutable so a
+    scenario can drift a node mid-run."""
+
+    def __init__(self, base: Clock, skew: float = 0.0):
+        self.base = base
+        self.skew = skew
+
+    def now(self) -> float:
+        return self.base.now() + self.skew
+
+    async def sleep(self, seconds: float) -> None:
+        await self.base.sleep(seconds)

@@ -1,11 +1,14 @@
-"""SLO engine: budget math, multi-window burn-rate breaches, and the
-`GET /v1/slo` document — all driven across a breach boundary with a
-FakeClock (no wall-clock sleeps anywhere)."""
+"""SLO engine: budget math, multi-window burn-rate breaches, the
+`GET /v1/slo` document, and per-group SLO overrides from the group
+TOML — all driven across breach boundaries with a FakeClock (no
+wall-clock sleeps anywhere)."""
 
 from types import SimpleNamespace
 
+import pytest
+
 from drand_tpu.obs import flight
-from drand_tpu.obs.slo import SLOEngine
+from drand_tpu.obs.slo import SLOEngine, parse_overrides
 from drand_tpu.utils.clock import FakeClock
 
 
@@ -126,3 +129,129 @@ async def test_slo_endpoint_across_breach_boundary():
         assert doc["time"] == clock.now()
     finally:
         await client.close()
+
+
+# -- per-group SLO overrides from the group TOML ---------------------------
+
+
+def test_parse_overrides_happy_path():
+    entries = [
+        {"Name": "round_finalize", "Target": 0.999,
+         "PeriodFraction": 0.25, "BudgetWindow": "2h",
+         "BucketSeconds": 30, "Describe": "tighter than default"},
+        {"Name": "partial_verify", "ThresholdSeconds": 0.2},
+    ]
+    out = parse_overrides(entries, period=30.0)
+    rf = out["round_finalize"]
+    assert rf["target"] == 0.999
+    assert rf["threshold"] == 7.5          # 0.25 * 30s period
+    assert rf["budget_window"] == 7200.0   # "2h"
+    assert rf["bucket_seconds"] == 30.0
+    assert rf["describe"] == "tighter than default"
+    assert out["partial_verify"] == {"threshold": 0.2}
+    # the kwargs feed ENGINE.objective verbatim
+    eng = SLOEngine(now_fn=lambda: 0.0)
+    eng.objective("round_finalize", **rf)
+    assert eng.get("round_finalize").threshold == 7.5
+
+
+def test_parse_overrides_rejects_malformed():
+    cases = [
+        ([{"Target": 0.9}], "Name is required"),
+        ([{"Name": "a"}, {"Name": "a"}], "declared twice"),
+        ([{"Name": "a", "Treshold": 1}], "unknown key"),
+        ([{"Name": "a", "Target": 1.5}], "Target must be in"),
+        ([{"Name": "a", "Target": 0.0}], "Target must be in"),
+        ([{"Name": "a", "ThresholdSeconds": 0}], "must be > 0"),
+        ([{"Name": "a", "ThresholdSeconds": 1, "PeriodFraction": 0.5}],
+         "not both"),
+        (["not-a-table"], "expected a table"),
+    ]
+    for entries, match in cases:
+        with pytest.raises(ValueError, match=match):
+            parse_overrides(entries, period=30.0)
+    # the fraction form is meaningless without a known period
+    with pytest.raises(ValueError):
+        parse_overrides([{"Name": "a", "PeriodFraction": 0.5}])
+
+
+def test_group_toml_round_trips_slo_overrides():
+    import random
+
+    from drand_tpu.key import Group, Pair
+    from drand_tpu.utils import toml_dumps
+    from drand_tpu.utils import tomlcompat as tomllib
+
+    r = random.Random(3)
+    pairs = [Pair.generate(f"127.0.0.1:{7000 + i}", rng=r.randbytes)
+             for i in range(3)]
+    slo = [{"Name": "round_finalize", "Target": 0.995,
+            "PeriodFraction": 0.4}]
+    g = Group(nodes=[p.public for p in pairs], threshold=2,
+              period=30.0, genesis_time=1000, slo=slo)
+    g2 = Group.from_dict(tomllib.loads(toml_dumps(g.to_dict())))
+    assert g2.slo == slo
+    # operational config must not change the chain's identity
+    bare = Group(nodes=[p.public for p in pairs], threshold=2,
+                 period=30.0, genesis_time=1000)
+    assert g.hash() == bare.hash()
+
+
+def test_beacon_config_rejects_bad_slo_at_configuration_time():
+    import random
+
+    from drand_tpu.beacon import BeaconConfig
+    from drand_tpu.crypto.poly import PriPoly
+    from drand_tpu.key import Group, Pair, Share
+    from drand_tpu.utils.clock import FakeClock as FC
+
+    r = random.Random(4)
+    pairs = [Pair.generate(f"127.0.0.1:{7100 + i}", rng=r.randbytes)
+             for i in range(3)]
+    poly = PriPoly.random(2, rng=r.randbytes)
+    commits = poly.commit().commits
+    group = Group(nodes=[p.public for p in pairs], threshold=2,
+                  period=30.0, genesis_time=1000,
+                  slo=[{"Name": "x", "Target": 2.0}])
+    with pytest.raises(ValueError, match="Target must be in"):
+        BeaconConfig(group=group, public=pairs[0].public,
+                     share=Share(commits=commits, share=poly.eval(0)),
+                     scheme=None, clock=FC())
+
+
+def test_handler_applies_group_overrides_first(monkeypatch):
+    """ENGINE.objective is first-registration-wins: the handler must
+    register the group file's [[SLO]] tables BEFORE its built-in
+    round_finalize default, so the group file is authoritative."""
+    import random
+
+    from drand_tpu.beacon import BeaconConfig, BeaconHandler, BeaconStore
+    from drand_tpu.crypto import tbls
+    from drand_tpu.crypto.poly import PriPoly
+    from drand_tpu.key import Group, Pair, Share
+    from drand_tpu.obs import slo as obs_slo
+    from drand_tpu.utils.clock import FakeClock as FC
+
+    fresh = SLOEngine(now_fn=lambda: 0.0)
+    monkeypatch.setattr(obs_slo, "ENGINE", fresh)
+
+    r = random.Random(5)
+    pairs = [Pair.generate(f"127.0.0.1:{7200 + i}", rng=r.randbytes)
+             for i in range(3)]
+    poly = PriPoly.random(2, rng=r.randbytes)
+    commits = poly.commit().commits
+    group = Group(
+        nodes=[p.public for p in pairs], threshold=2, period=30.0,
+        genesis_time=1000,
+        slo=[{"Name": obs_slo.ROUND_FINALIZE, "Target": 0.9999,
+              "PeriodFraction": 0.1, "BudgetWindow": "1h"}],
+    )
+    cfg = BeaconConfig(group=group, public=pairs[0].public,
+                       share=Share(commits=commits, share=poly.eval(0)),
+                       scheme=tbls._native_scheme_or_ref(), clock=FC())
+    BeaconHandler(cfg, BeaconStore(), client=None)
+    obj = fresh.get(obs_slo.ROUND_FINALIZE)
+    assert obj is not None
+    assert obj.target == 0.9999
+    assert obj.threshold == 3.0          # 0.1 * 30s, not the 15s default
+    assert obj.budget_window == 3600.0

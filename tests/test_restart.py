@@ -102,3 +102,30 @@ async def test_restart_catchup_over_grpc(tmp_path):
         await c.close()
     for d in daemons[:3] + [restarted]:
         await d.stop()
+
+
+def test_sim_crash_restart_replays_deterministically():
+    """Crash-restart under the simulator: a node is killed mid-round
+    (its partial already in flight), restarts from its surviving store,
+    catch-up syncs, and converges with the group — and the ENTIRE run,
+    including the crash, the restart, and every post-restart delivery,
+    replays to a byte-identical event log from the same seed."""
+    import json
+
+    from drand_tpu.sim import run_scenario
+
+    a = run_scenario("crash_restart", seed=13)
+    assert a.passed, (a.failures, a.violations)
+    assert not a.violations
+    # the crashed node rejoined and converged with everyone else
+    assert a.heads["sim04"] >= max(a.heads.values()) - 1
+    events = json.loads(a.event_log)["events"]
+    kinds = [e["kind"] for e in events]
+    assert "node_crash" in kinds and "node_restart" in kinds
+    # rounds stored by incarnation 1 prove the restart produced, not
+    # just the pre-crash process
+    assert any(e["kind"] == "round_stored" and e["node"] == "sim04"
+               and e.get("incarnation") == 1 for e in events)
+
+    b = run_scenario("crash_restart", seed=13)
+    assert a.event_log == b.event_log

@@ -1,0 +1,50 @@
+"""Nightly random-seed simulation sweep (slow tier).
+
+Each run picks fresh random seeds (from the OS, not from any fixed
+list), runs every scripted scenario under them, and re-runs one of them
+to prove the replay is byte-identical.  ON FAILURE THE SEED IS IN THE
+ASSERTION MESSAGE — replay it exactly with:
+
+    drand-tpu sim run --scenario <name> --seed <seed>
+
+The sweep exists to walk the schedule space the fixed-seed tier-1 tests
+can't: every seed is a different interleaving of deliveries, drops,
+jitter, and fault timing.
+"""
+
+import os
+
+import pytest
+
+from drand_tpu.sim import SCENARIOS, run_scenario
+
+pytestmark = pytest.mark.slow
+
+#: seeds per scenario per nightly run — the sweep's breadth knob
+SEEDS_PER_SCENARIO = 2
+
+
+def _random_seed() -> int:
+    return int.from_bytes(os.urandom(4), "big")
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_random_seed_sweep(name):
+    for _ in range(SEEDS_PER_SCENARIO):
+        seed = _random_seed()
+        report = run_scenario(name, seed=seed)
+        assert report.passed, (
+            f"REPLAY WITH: drand-tpu sim run --scenario {name} "
+            f"--seed {seed} — failures={report.failures} "
+            f"violations={report.violations} heads={report.heads}"
+        )
+
+
+def test_random_seed_replays_byte_identically():
+    seed = _random_seed()
+    a = run_scenario("partition", seed=seed)
+    b = run_scenario("partition", seed=seed)
+    assert a.event_log == b.event_log, (
+        f"REPLAY WITH: drand-tpu sim run --scenario partition "
+        f"--seed {seed} (twice) — event logs diverged"
+    )
