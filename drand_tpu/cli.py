@@ -323,6 +323,17 @@ def cmd_verify_serve(args) -> int:
         return 1
 
     async def run() -> int:
+        ring = None
+        if args.ring:
+            from drand_tpu.net.transport import GrpcClient
+            from drand_tpu.serve import ReplicaRing, grpc_forwarder
+
+            peers = [p.strip() for p in args.ring.split(",") if p.strip()]
+            self_id = args.replica_id or f"127.0.0.1:{args.port}"
+            ring = ReplicaRing(
+                self_id, [p for p in peers if p != self_id],
+                forward=grpc_forwarder(GrpcClient()),
+            )
         gateway = VerifyGateway(
             dist_key,
             tbls.default_scheme(args.backend),
@@ -331,15 +342,21 @@ def cmd_verify_serve(args) -> int:
             max_queue=args.max_queue,
             cache_size=args.cache_size,
             client_max_inflight=args.client_max_inflight,
+            mesh_devices=args.mesh_devices,
+            ring=ring,
         )
         await gateway.start()
         runner, port = await start_rest(
             build_verify_app(gateway), args.port
         )
+        mesh = gateway.stats()["mesh"]
         print(f"verify gateway on :{port} "
               f"(max_batch={args.max_batch}, max_wait={args.max_wait}s, "
               f"queue={args.max_queue}, "
-              f"backend={type(gateway.scheme).__name__})", flush=True)
+              f"backend={type(gateway.scheme).__name__}, "
+              f"mesh={mesh['devices']}x{mesh['backend'] or '-'}"
+              + (f", ring={ring.ring.members()}" if ring else "")
+              + ")", flush=True)
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
@@ -867,6 +884,24 @@ def build_parser() -> argparse.ArgumentParser:
         "--compile-cache", metavar="DIR",
         help="persistent XLA compile cache directory "
              "(same semantics as `start --compile-cache`)",
+    )
+    g.add_argument(
+        "--mesh-devices", type=int, default=1,
+        help="device lanes per flush: > 1 dispatches each batch as ONE "
+             "mesh-sharded pairing program (8 virtual CPU devices via "
+             "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+    )
+    g.add_argument(
+        "--ring", metavar="PEERS",
+        help="comma-separated gateway replica addresses forming a "
+             "consistent-hash ring over round numbers; off-owner "
+             "requests forward once over gRPC and fall back to local "
+             "serving on failure",
+    )
+    g.add_argument(
+        "--replica-id", metavar="ADDR",
+        help="this replica's own address in --ring "
+             "(default 127.0.0.1:<port>)",
     )
     g.set_defaults(fn=cmd_verify_serve)
 

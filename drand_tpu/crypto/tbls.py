@@ -978,6 +978,99 @@ class JaxScheme(Scheme):
             out[i] = bool(ok[j])
         return out
 
+    # -- explicit gateway mesh (serve/ mesh-sharded batch scheduler) ------
+
+    def configure_mesh(self, n_devices: int) -> str:
+        """Build the explicit `n_devices` mesh used by
+        `verify_chain_batch_mesh` and return the platform actually
+        backing it ("cpu", "tpu", ...) — callers surface that in status
+        so virtual-CPU numbers can't masquerade as TPU numbers.
+
+        Distinct from `_maybe_sharded`: that one opportunistically
+        shards LARGE single batches over every visible device; this one
+        is the gateway's fixed-width mesh whose lane assembly the
+        scheduler controls."""
+        from drand_tpu.parallel import shard
+
+        mesh = shard.device_mesh(n_devices)
+        self._gw_mesh = mesh
+        self._gw_sharded_check = shard.sharded_pairing_check(mesh)
+        self._gw_mesh_backend = shard.mesh_backend(mesh)
+        return self._gw_mesh_backend
+
+    def verify_chain_batch_mesh(self, pub_key, lane_msgs, lane_sigs):
+        """ONE mesh-sharded pairing dispatch over per-device lanes.
+
+        `lane_msgs` / `lane_sigs` hold one list per mesh device (empty
+        lanes allowed).  Every lane pads to the SHARED per-device bucket
+        — `_bucket(longest lane)` — so the concatenated batch is one
+        fixed shape whose leading axis NamedSharding splits contiguously:
+        lane k lands wholly on device k.  Returns per-lane verdict lists
+        mirroring the input shapes."""
+        mesh = getattr(self, "_gw_mesh", None)
+        if mesh is None:
+            raise RuntimeError(
+                "verify_chain_batch_mesh requires configure_mesh()"
+            )
+        ndev = mesh.devices.size
+        if len(lane_msgs) != ndev or len(lane_sigs) != ndev:
+            raise ValueError(
+                f"expected {ndev} lanes, got {len(lane_msgs)}"
+            )
+        lane_pts, lane_live = [], []
+        for sigs in lane_sigs:
+            pts, live = [], []
+            for i, sig in enumerate(sigs):
+                try:
+                    pt = (ref.g2_from_bytes(sig)
+                          if isinstance(sig, (bytes, bytearray)) else sig)
+                    if pt is None:
+                        raise ThresholdError("identity signature")
+                    pts.append(pt)
+                    live.append(i)
+                except (ThresholdError, ValueError):
+                    pts.append(None)
+            lane_pts.append(pts)
+            lane_live.append(live)
+        total_live = sum(len(l) for l in lane_live)
+        if not total_live:
+            return [[False] * len(sigs) for sigs in lane_sigs]
+        per_dev = self._bucket(max(len(l) for l in lane_live))
+        nb = per_dev * ndev
+        # lanes with no live rows re-check the first live row found
+        # anywhere (same padding idiom as verify_chain_batch)
+        fk = next(k for k, l in enumerate(lane_live) if l)
+        fb_pt = lane_pts[fk][lane_live[fk][0]]
+        fb_msg = lane_msgs[fk][lane_live[fk][0]]
+        row_pts, row_msgs = [], []
+        for k in range(ndev):
+            live = lane_live[k]
+            if live:
+                rows = live + [live[0]] * (per_dev - len(live))
+                row_pts.extend(lane_pts[k][i] for i in rows)
+                row_msgs.extend(lane_msgs[k][i] for i in rows)
+            else:
+                row_pts.extend([fb_pt] * per_dev)
+                row_msgs.extend([fb_msg] * per_dev)
+        neg_row, pk_row = self._chain_rows(pub_key)
+        p1 = self._jnp.broadcast_to(neg_row, (nb, 2, self._nlimb))
+        q1 = self._curve.g2_affine_encode_batch(row_pts)
+        p2 = self._jnp.broadcast_to(pk_row, (nb, 2, self._nlimb))
+        with kernel_span("pairing_check", backend="jax",
+                         batch=total_live, padded=nb, devices=ndev,
+                         mesh=True):
+            u0, u1 = self._h2c.hash_to_field_device(row_msgs)
+            q2 = self._h2c.map_and_clear_g2_affine(u0, u1)
+            ok = np.asarray(self._gw_sharded_check(p1, q1, p2, q2))
+        out = []
+        for k in range(ndev):
+            verdicts = [False] * len(lane_sigs[k])
+            base = k * per_dev
+            for j, i in enumerate(lane_live[k]):
+                verdicts[i] = bool(ok[base + j])
+            out.append(verdicts)
+        return out
+
 
 _DEFAULT: Optional[Scheme] = None
 

@@ -116,6 +116,9 @@ async def handle_verify(gateway, request):
     # socket peer otherwise; trace header joins a distributed trace
     client = request.headers.get("X-Client-Id") or request.remote
     trace_id = request.headers.get("X-Trace-Id", "")
+    # ring forward-once marker: set by a sibling replica — the owner
+    # serves locally and never re-forwards (no routing loops)
+    forwarded = request.headers.get("X-Drand-Forwarded") is not None
 
     if "items" in body:
         reqs = [_parse_verify_claim(j) for j in body["items"]]
@@ -137,7 +140,8 @@ async def handle_verify(gateway, request):
     req = _parse_verify_claim(body)
     try:
         res = await gateway.verify(req, timeout, client=client,
-                                   trace_id=trace_id or None)
+                                   trace_id=trace_id or None,
+                                   forwarded=forwarded)
     except serve.Oversize as exc:
         raise web.HTTPRequestEntityTooLarge(
             max_size=exc.limit, actual_size=exc.actual, text=str(exc)

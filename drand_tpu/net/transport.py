@@ -157,11 +157,18 @@ def build_public_server(daemon, address: str,
             prev_sig=request.previous_signature,
             signature=request.signature,
         )
+        # ring forward-once marker: a forwarded request must be served
+        # locally by the owner, never re-forwarded (no routing loops)
+        forwarded = any(
+            k == "x-drand-forwarded"
+            for k, _ in (context.invocation_metadata() or ())
+        )
         try:
             res = await gw.verify(
                 req, request.timeout_seconds or None,
                 client=context.peer(),
                 trace_id=request.trace_id or None,
+                forwarded=forwarded,
             )
         except serve.Oversize as exc:
             await context.abort(
@@ -684,11 +691,16 @@ class GrpcClient(ProtocolClient):
                             prev_round: int, prev_sig: bytes,
                             signature: bytes,
                             timeout: Optional[float] = None,
-                            trace_id: str = ""
+                            trace_id: str = "",
+                            forwarded: bool = False
                             ) -> "pb.VerifyBeaconResponse":
         """Remote verification of one chain link through the peer's
         serve/ gateway.  The peer sheds with RESOURCE_EXHAUSTED /
-        DEADLINE_EXCEEDED instead of holding the call open."""
+        DEADLINE_EXCEEDED instead of holding the call open.
+
+        `forwarded=True` marks a ring forward (metadata
+        `x-drand-forwarded`): the receiving owner serves locally and
+        never re-forwards, so a stale ring view cannot loop."""
         call = self._method(
             peer, f"/{PUBLIC_SERVICE}/VerifyBeacon",
             pb.VerifyBeaconRequest.SerializeToString,
@@ -700,9 +712,10 @@ class GrpcClient(ProtocolClient):
             timeout_seconds=timeout or 0.0,
             trace_id=trace_id,
         )
-        return await call(
-            req, timeout=(timeout or 0.0) + CONTROL_TIMEOUT
-        )
+        kwargs = {"timeout": (timeout or 0.0) + CONTROL_TIMEOUT}
+        if forwarded:
+            kwargs["metadata"] = (("x-drand-forwarded", "1"),)
+        return await call(req, **kwargs)
 
     async def verify_beacon_batch(self, peer: Identity, items,
                                   timeout: Optional[float] = None

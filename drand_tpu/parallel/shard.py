@@ -33,8 +33,20 @@ from drand_tpu.ops.curve import (
     point_identity,
 )
 from drand_tpu.ops.msm import _msm as msm_local
+from drand_tpu.utils.logging import get_logger
+
+log = get_logger("parallel.shard")
 
 CHAIN_AXIS = "chains"
+
+# one-time guard for the CPU-fallback warning: a silent fallback lets a
+# loadgen artifact masquerade virtual-CPU numbers as TPU numbers
+_warned_fallback = False
+
+
+def mesh_backend(mesh: Mesh) -> str:
+    """Platform name of the devices backing `mesh` ("cpu", "tpu", ...)."""
+    return mesh.devices.flat[0].platform
 
 
 def device_mesh(n_devices: int, axis: str = CHAIN_AXIS) -> Mesh:
@@ -42,11 +54,26 @@ def device_mesh(n_devices: int, axis: str = CHAIN_AXIS) -> Mesh:
 
     Prefers the default backend's devices; falls back to the virtual CPU
     pool (``--xla_force_host_platform_device_count``) when the default
-    backend is a single chip.
+    backend is a single chip.  The fallback logs a one-time warning
+    naming the backend actually used — artifacts must record it (see
+    `mesh_backend`), never assume the default backend was honored.
     """
+    global _warned_fallback
     devices = jax.devices()
+    default_platform = devices[0].platform if devices else "none"
     if len(devices) < n_devices:
         devices = jax.devices("cpu")
+        if not _warned_fallback:
+            _warned_fallback = True
+            log.warning(
+                "default backend has too few devices; mesh falls back "
+                "to the virtual CPU pool — numbers from this mesh are "
+                "CPU numbers",
+                default_backend=default_platform,
+                default_devices=len(jax.devices()),
+                mesh_backend="cpu",
+                requested=n_devices,
+            )
     if len(devices) < n_devices:
         raise RuntimeError(
             f"need {n_devices} devices, have {len(devices)}"

@@ -16,7 +16,11 @@ kernel) and explicit shedding (429 / RESOURCE_EXHAUSTED) instead of
 unbounded queueing latency.  See README.md "Verification gateway".
 """
 
-from drand_tpu.serve.batcher import BatchItem, BatchScheduler
+from drand_tpu.serve.batcher import (
+    BatchItem,
+    BatchScheduler,
+    assemble_lanes,
+)
 from drand_tpu.serve.cache import VerifiedRoundCache
 from drand_tpu.serve.gateway import (
     ClientQuota,
@@ -29,6 +33,12 @@ from drand_tpu.serve.gateway import (
     VerifyRequest,
     VerifyResult,
 )
+from drand_tpu.serve.ring import (
+    HashRing,
+    ReplicaRing,
+    grpc_forwarder,
+    inprocess_forwarder,
+)
 
 __all__ = [
     "BatchItem",
@@ -37,10 +47,15 @@ __all__ = [
     "DeadlineExceeded",
     "GatewayClosed",
     "GatewayError",
+    "HashRing",
     "Overloaded",
     "Oversize",
+    "ReplicaRing",
     "VerifiedRoundCache",
     "VerifyGateway",
     "VerifyRequest",
     "VerifyResult",
+    "assemble_lanes",
+    "grpc_forwarder",
+    "inprocess_forwarder",
 ]

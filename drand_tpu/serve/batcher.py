@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import asyncio
 from collections import OrderedDict, deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Awaitable, Callable, List, Optional
 
 from drand_tpu.utils.logging import get_logger
@@ -41,13 +41,17 @@ class BatchItem:
     `deadline` is an absolute event-loop time; the flush callback drops
     items already past it (reject-at-pop, never serve-late).  `payload`
     is opaque to the scheduler — the gateway stores its request there.
+
+    `future` stays None until `submit` binds one on the RUNNING loop:
+    a default factory calling `asyncio.get_event_loop()` would bind
+    whatever loop (or fresh implicit loop) is current on the
+    CONSTRUCTING thread, so an item built on a worker thread would
+    carry a future no running loop ever resolves.
     """
 
     payload: object
     deadline: Optional[float] = None
-    future: "asyncio.Future" = field(
-        default_factory=lambda: asyncio.get_event_loop().create_future()
-    )
+    future: Optional["asyncio.Future"] = None
     #: the submitter's request span (obs.trace.Span or None) — the flush
     #: callback stamps batch links onto it so a request's trace shows
     #: which kernel batch served it
@@ -58,18 +62,47 @@ class BatchItem:
     client: Optional[str] = None
 
 
+def assemble_lanes(items: List[BatchItem],
+                   n_lanes: int) -> List[List[BatchItem]]:
+    """Deal one flush's items into per-device lanes, round-robin.
+
+    The mesh scheduler's batch-assembly policy: every lane (device)
+    receives within one item of every other, so the shared per-device
+    bucket shape — every lane pads to the LARGEST lane's bucket — wastes
+    at most one real row per device.  Empty lanes are kept (a 3-item
+    batch on an 8-device mesh still dispatches one 8-way program; the
+    padding lanes re-check the first row, same idiom as the batch
+    padding in tbls.JaxScheme)."""
+    if n_lanes < 1:
+        raise ValueError("n_lanes must be >= 1")
+    lanes: List[List[BatchItem]] = [[] for _ in range(n_lanes)]
+    for i, item in enumerate(items):
+        lanes[i % n_lanes].append(item)
+    return lanes
+
+
 class BatchScheduler:
     """Bounded queue + flush loop.  `flush(items)` is an async callback
-    that must resolve every item's future (verdict or exception)."""
+    that must resolve every item's future (verdict or exception).
+
+    `lanes` declares how many device lanes a flush will be dealt into
+    (`assemble_lanes`); the scheduler itself still collects ONE batch of
+    up to `max_batch` items — with lanes > 1 that budget is the TOTAL
+    across the mesh, so single- and multi-device schedulers are compared
+    at equal batch budget."""
 
     def __init__(self, flush: Callable[[List[BatchItem]], Awaitable[None]],
                  *, max_batch: int = 128, max_wait: float = 0.005,
                  max_queue: int = 1024,
-                 key_of: Optional[Callable[[BatchItem], object]] = None):
+                 key_of: Optional[Callable[[BatchItem], object]] = None,
+                 lanes: int = 1):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
+        if lanes < 1:
+            raise ValueError("lanes must be >= 1")
+        self.lanes = lanes
         self._flush = flush
         self.max_batch = max_batch
         self.max_wait = max_wait
@@ -95,6 +128,10 @@ class BatchScheduler:
         admission must never itself wait behind the backlog."""
         if self._closed:
             raise RuntimeError("scheduler is closed")
+        if item.future is None:
+            # bind the future here, on the loop that will resolve it —
+            # items may be CONSTRUCTED off-loop (worker threads, tests)
+            item.future = asyncio.get_running_loop().create_future()
         if self._key_of is None:
             self._queue.put_nowait(item)
             return

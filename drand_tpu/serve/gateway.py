@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set
@@ -46,8 +47,13 @@ from drand_tpu.crypto import tbls
 from drand_tpu.obs import flight as obs_flight
 from drand_tpu.obs import slo as obs_slo
 from drand_tpu.obs import trace as obs_trace
-from drand_tpu.serve.batcher import BatchItem, BatchScheduler
+from drand_tpu.serve.batcher import (
+    BatchItem,
+    BatchScheduler,
+    assemble_lanes,
+)
 from drand_tpu.serve.cache import VerifiedRoundCache
+from drand_tpu.serve.ring import ReplicaRing
 from drand_tpu.utils import metrics
 from drand_tpu.utils.logging import get_logger
 
@@ -74,6 +80,15 @@ _cache_hits = metrics.counter(
 _coalesced = metrics.counter(
     "drand_serve_coalesced_total", "requests attached to an identical "
     "in-flight verification"
+)
+_device_occupancy = metrics.histogram(
+    "drand_serve_device_occupancy",
+    "live requests assigned to one device lane per mesh flush",
+    buckets=_BATCH_BUCKETS,
+)
+_mesh_batches = metrics.counter(
+    "drand_serve_mesh_batches_total",
+    "flushes dispatched as one mesh-sharded pairing program",
 )
 _shed = {
     reason: metrics.counter(
@@ -201,6 +216,8 @@ class VerifyResult:
     #: live size of the kernel batch that produced the verdict (0 when
     #: the cache answered)
     batch_size: int = 0
+    #: the verdict came from the ring owner, not this replica
+    forwarded: bool = False
 
 
 class VerifyGateway:
@@ -214,13 +231,35 @@ class VerifyGateway:
                  max_batch: int = 128, max_wait: float = 0.005,
                  max_queue: int = 1024, cache_size: int = 4096,
                  default_timeout: float = 5.0,
-                 client_max_inflight: Optional[int] = None):
+                 client_max_inflight: Optional[int] = None,
+                 mesh_devices: int = 1,
+                 ring: Optional[ReplicaRing] = None):
         if isinstance(dist_key, (bytes, bytearray)):
             dist_key = ref.g1_from_bytes(bytes(dist_key))
+        if mesh_devices < 1:
+            raise ValueError("mesh_devices must be >= 1")
         self.dist_key = dist_key
         self.scheme = scheme or tbls.default_scheme()
         self.default_timeout = default_timeout
         self.cache = VerifiedRoundCache(cache_size)
+        # mesh scheduler: with > 1 device lanes a flush is dealt into
+        # per-device lanes and dispatched as ONE sharded pairing program
+        # (scheme.verify_chain_batch_mesh); max_batch stays the TOTAL
+        # budget so single- and mesh-sharded runs compare like-for-like.
+        # Default (1) keeps the single-device scheduler byte-identical.
+        self.mesh_devices = mesh_devices
+        self._mesh_backend: Optional[str] = None
+        self._mesh_batch_count = 0
+        if mesh_devices > 1 and not hasattr(self.scheme,
+                                            "verify_chain_batch_mesh"):
+            log.warning("scheme has no mesh support; falling back to "
+                        "the single-device scheduler",
+                        scheme=type(self.scheme).__name__,
+                        mesh_devices=mesh_devices)
+            self.mesh_devices = 1
+        # replica ring: off-owner requests forward once to the round's
+        # owner and serve locally on failure (never a hard dependency)
+        self.ring = ring
         # anonymous callers share only the global queue bound; identified
         # clients additionally get this in-flight cap (default: 3/4 of
         # the queue, so one identity can never fill it alone)
@@ -232,6 +271,7 @@ class VerifyGateway:
         self._batcher = BatchScheduler(
             self._flush, max_batch=max_batch, max_wait=max_wait,
             max_queue=max_queue, key_of=lambda item: item.client,
+            lanes=self.mesh_devices,
         )
         #: key -> BatchItem for claims already queued: identical claims
         #: share one kernel slot and one verdict
@@ -242,6 +282,11 @@ class VerifyGateway:
         # per-instance cache accounting for /v1/status hit rate
         self._hits = 0
         self._misses = 0
+        # per-instance flush accounting: the scheduler-throughput number
+        # (items per second of flush wall-clock) the loadgen artifact
+        # compares across mesh sizes, free of client-side overhead
+        self._flush_seconds = 0.0
+        self._flush_items = 0
         obs_slo.ENGINE.objective(
             obs_slo.VERIFY_LATENCY,
             target=VERIFY_SLO_TARGET,
@@ -257,16 +302,27 @@ class VerifyGateway:
         if self._started:
             return
         self._started = True
-        # one worker: the device stream is serial anyway, and a second
-        # concurrent dispatch would only fight for the same chip
+        # one worker: the device stream is serial anyway (the mesh path
+        # too — it is ONE sharded program, XLA spreads it), and a second
+        # concurrent dispatch would only fight for the same chips
         self._executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="verify-gateway"
         )
+        if self.mesh_devices > 1:
+            # let the scheme build its mesh up front so a mesh that
+            # cannot be constructed fails at start, not mid-flush
+            configure = getattr(self.scheme, "configure_mesh", None)
+            if configure is not None:
+                self._mesh_backend = configure(self.mesh_devices)
         self._batcher.start()
         log.info("verification gateway started",
                  max_batch=self._batcher.max_batch,
                  max_wait=self._batcher.max_wait,
-                 backend=type(self.scheme).__name__)
+                 backend=type(self.scheme).__name__,
+                 mesh_devices=self.mesh_devices,
+                 mesh_backend=self._mesh_backend,
+                 ring=(self.ring.stats()["replicas"]
+                       if self.ring is not None else None))
 
     async def close(self) -> None:
         if self._closed:
@@ -295,12 +351,15 @@ class VerifyGateway:
     async def verify(self, req: VerifyRequest,
                      timeout: Optional[float] = None, *,
                      client: Optional[str] = None,
-                     trace_id: Optional[str] = None) -> VerifyResult:
+                     trace_id: Optional[str] = None,
+                     forwarded: bool = False) -> VerifyResult:
         """Verify one claim; returns a verdict or raises a GatewayError.
 
         `client` is an opaque caller identity (peer address / header) for
         the per-client request counters; `trace_id` joins the caller's
-        distributed trace when propagated."""
+        distributed trace when propagated.  `forwarded` marks a claim
+        relayed by a sibling ring replica: it is always served here
+        (forward exactly once, even when ring views disagree)."""
         if self._closed or not self._started:
             raise GatewayClosed("gateway is not serving")
         _count_client_request(client)
@@ -313,7 +372,8 @@ class VerifyGateway:
             "gateway.verify", trace_id=trace_id or None, attrs=attrs,
         ) as span:
             try:
-                res = await self._verify_inner(req, timeout, span, client)
+                res = await self._verify_inner(req, timeout, span, client,
+                                               forwarded=forwarded)
             except GatewayError:
                 # a request we refused or lost IS an SLO event: the
                 # caller asked and was not answered
@@ -325,8 +385,8 @@ class VerifyGateway:
 
     async def _verify_inner(self, req: VerifyRequest,
                             timeout: Optional[float],
-                            span, client: Optional[str] = None
-                            ) -> VerifyResult:
+                            span, client: Optional[str] = None,
+                            forwarded: bool = False) -> VerifyResult:
         n = max(len(req.signature), len(req.prev_sig))
         if n > tbls.SIG_LEN:
             _shed["oversize"].inc()
@@ -341,6 +401,11 @@ class VerifyGateway:
             span.set_attr("cached", True)
             return VerifyResult(valid=True, cached=True)
         self._misses += 1
+
+        if self.ring is not None and not forwarded:
+            res = await self._ring_forward(req, timeout, span, client)
+            if res is not None:
+                return res
 
         loop = asyncio.get_event_loop()
         timeout = self.default_timeout if timeout is None else timeout
@@ -407,6 +472,44 @@ class VerifyGateway:
                 f"no verdict within {timeout:.3f}s"
             ) from None
 
+    async def _ring_forward(self, req: VerifyRequest,
+                            timeout: Optional[float], span,
+                            client: Optional[str]
+                            ) -> Optional[VerifyResult]:
+        """Route an off-owner claim to its ring owner; None means "serve
+        locally" (we own it, no forwarder, or the forward failed — a
+        replica never hard-depends on its siblings)."""
+        owner = self.ring.owner(req.round)
+        if owner == self.ring.self_id or not self.ring.can_forward:
+            return None
+        span.set_attr("ring_owner", owner)
+        try:
+            res = await self.ring.forward(owner, req, timeout, client)
+        except GatewayClosed:
+            # dead or closing owner: a strike (eviction re-owns its
+            # rounds after fail_evict in a row), then serve locally
+            self.ring.note_failure(owner)
+            self.ring.note_local_fallback()
+            span.set_attr("ring_fallback", "owner_closed")
+            return None
+        except GatewayError:
+            # the owner answered with an explicit shed: it is alive
+            # (no strike), but this replica still owes a verdict
+            self.ring.note_alive(owner)
+            self.ring.note_local_fallback()
+            span.set_attr("ring_fallback", "owner_shed")
+            return None
+        except Exception as exc:  # noqa: BLE001 — transport failure
+            self.ring.note_failure(owner)
+            self.ring.note_local_fallback()
+            span.set_attr("ring_fallback", "transport")
+            log.warning("ring forward failed; serving locally",
+                        owner=owner, round=req.round, error=repr(exc))
+            return None
+        self.ring.note_alive(owner)
+        span.set_attr("forwarded", True)
+        return res
+
     async def verify_many(self, reqs: Sequence[VerifyRequest],
                           timeout: Optional[float] = None, *,
                           client: Optional[str] = None
@@ -433,6 +536,18 @@ class VerifyGateway:
             "cache_entries": len(self.cache),
             "cache_hit_rate": (self._hits / total) if total else None,
             "closed": self._closed,
+            # shard/ring visibility: loadgen artifacts and operators read
+            # the mesh BACKEND here, so a CPU-pool fallback can never
+            # masquerade as TPU numbers
+            "mesh": {
+                "devices": self.mesh_devices,
+                "backend": self._mesh_backend,
+                "sharded_batches": self._mesh_batch_count,
+            },
+            "ring": (self.ring.stats() if self.ring is not None
+                     else None),
+            "flush_seconds": round(self._flush_seconds, 6),
+            "flush_items": self._flush_items,
         }
 
     # -- batch flush (BatchScheduler callback) -----------------------------
@@ -448,9 +563,34 @@ class VerifyGateway:
         else:
             self._client_inflight[client] = left
 
+    # the flush-throughput clocks run INSIDE the (single) executor
+    # thread, right around the backend call: event-loop backlog while
+    # thousands of client coroutines churn must not pollute the
+    # scheduler-throughput number the loadgen artifact compares across
+    # mesh sizes
+
     def _run_kernel(self, msgs: List[bytes],
                     sigs: List[bytes]) -> List[bool]:
-        return self.scheme.verify_chain_batch(self.dist_key, msgs, sigs)
+        t0 = time.perf_counter()
+        try:
+            return self.scheme.verify_chain_batch(
+                self.dist_key, msgs, sigs
+            )
+        finally:
+            self._flush_seconds += time.perf_counter() - t0
+            self._flush_items += len(msgs)
+
+    def _run_kernel_mesh(self, lane_msgs: List[List[bytes]],
+                         lane_sigs: List[List[bytes]]
+                         ) -> List[List[bool]]:
+        t0 = time.perf_counter()
+        try:
+            return self.scheme.verify_chain_batch_mesh(
+                self.dist_key, lane_msgs, lane_sigs
+            )
+        finally:
+            self._flush_seconds += time.perf_counter() - t0
+            self._flush_items += sum(len(l) for l in lane_msgs)
 
     async def _flush(self, items: List[BatchItem]) -> None:
         loop = asyncio.get_event_loop()
@@ -473,12 +613,17 @@ class VerifyGateway:
             live.append(item)
         if not live:
             return
-        msgs = [item.payload.message() for item in live]
-        sigs = [item.payload.signature for item in live]
+        mesh = (self.mesh_devices > 1)
         _batch_size.observe(float(len(live)))
-        with obs_trace.TRACER.span(
-            "gateway.batch", attrs={"requests": len(live)},
-        ) as bspan:
+        attrs = {"requests": len(live)}
+        if mesh:
+            lanes = assemble_lanes(live, self.mesh_devices)
+            for lane in lanes:
+                _device_occupancy.observe(float(len(lane)))
+            _mesh_batches.inc()
+            self._mesh_batch_count += 1
+            attrs["devices"] = self.mesh_devices
+        with obs_trace.TRACER.span("gateway.batch", attrs=attrs) as bspan:
             # link every request span to the batch that served it (and
             # vice versa the batch id is enough to find all riders)
             if bspan.span_id is not None:
@@ -491,9 +636,24 @@ class VerifyGateway:
                 # (unlike asyncio.to_thread) — carry it explicitly so the
                 # backend's kernel spans parent to this batch span
                 ctx = contextvars.copy_context()
-                verdicts = await loop.run_in_executor(
-                    self._executor, ctx.run, self._run_kernel, msgs, sigs
-                )
+                if mesh:
+                    lane_msgs = [[i.payload.message() for i in lane]
+                                 for lane in lanes]
+                    lane_sigs = [[i.payload.signature for i in lane]
+                                 for lane in lanes]
+                    lane_verdicts = await loop.run_in_executor(
+                        self._executor, ctx.run, self._run_kernel_mesh,
+                        lane_msgs, lane_sigs,
+                    )
+                    live = [i for lane in lanes for i in lane]
+                    verdicts = [v for lane in lane_verdicts for v in lane]
+                else:
+                    msgs = [item.payload.message() for item in live]
+                    sigs = [item.payload.signature for item in live]
+                    verdicts = await loop.run_in_executor(
+                        self._executor, ctx.run, self._run_kernel,
+                        msgs, sigs,
+                    )
         for item, ok in zip(live, verdicts):
             ok = bool(ok)
             _requests["valid" if ok else "invalid"].inc()

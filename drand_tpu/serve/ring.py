@@ -1,0 +1,268 @@
+"""Consistent-hash replica ring: round number -> owning gateway replica.
+
+A beacon emits ONE new round per period, so the verification read path
+is overwhelmingly cacheable — the limiting resource across N gateway
+replicas is not kernel throughput but CACHE capacity and hit rate.  A
+plain replica pool caches every hot round N times and still misses on
+the long tail; a consistent-hash ring keyed on round number gives every
+round exactly one owner, so the per-replica verified-round LRUs compose
+into one distributed cache whose capacity scales with N (CDN-style
+request routing, vLLM/Orca-style only in spirit: admission stays local).
+
+Forwarding is best-effort by design: a replica receiving an off-owner
+request forwards ONCE to the owner and serves locally when the forward
+fails — replicas never hard-depend on each other, and a dead owner is
+evicted from the local ring view after `fail_evict` consecutive
+transport failures so its rounds are re-owned by the survivors
+(minimal-movement property of the ring: only the dead replica's rounds
+move).
+
+`HashRing` is the pure data structure (deterministic across processes:
+SHA-256 points, no PYTHONHASHSEED exposure); `ReplicaRing` wires it to a
+gateway with a pluggable async `forward(owner, req, timeout, client)`
+callable — gRPC in production (`grpc_forwarder`), in-process for
+loadgen and the chaos scenarios.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+from typing import Awaitable, Callable, Dict, List, Optional, Sequence
+
+from drand_tpu.utils import metrics
+from drand_tpu.utils.logging import get_logger
+
+log = get_logger("serve.ring")
+
+_forwarded = metrics.counter(
+    "drand_serve_ring_forwarded_total",
+    "off-owner requests forwarded to the ring owner",
+)
+_forward_failures = metrics.counter(
+    "drand_serve_ring_forward_failures_total",
+    "forwards that failed at the transport (served locally instead)",
+)
+_local_fallback = metrics.counter(
+    "drand_serve_ring_local_fallback_total",
+    "off-owner requests served locally (owner shed or unreachable)",
+)
+_evicted = metrics.counter(
+    "drand_serve_ring_evicted_total",
+    "replicas evicted from the local ring view after repeated "
+    "forward failures",
+)
+
+
+def _point(data: bytes) -> int:
+    """64-bit ring position: stable across processes and hash seeds."""
+    return int.from_bytes(hashlib.sha256(data).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent hashing of round numbers onto replica ids.
+
+    Each replica contributes `vnodes` virtual points so ownership spreads
+    evenly; `owner(round)` walks clockwise from the round's point.  Two
+    properties the tests pin down: assignment is STABLE (same members ->
+    same owner map, in any construction order, in any process) and
+    membership changes move only the joining/leaving replica's rounds.
+    """
+
+    def __init__(self, replicas: Sequence[str] = (), vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self._vnodes = vnodes
+        self._hashes: List[int] = []     # sorted ring positions
+        self._owners: List[str] = []     # owner at each position
+        self._members: set = set()
+        for r in replicas:
+            self.add(r)
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def __contains__(self, replica: str) -> bool:
+        return replica in self._members
+
+    def members(self) -> List[str]:
+        return sorted(self._members)
+
+    def add(self, replica: str) -> None:
+        if replica in self._members:
+            return
+        self._members.add(replica)
+        for v in range(self._vnodes):
+            h = _point(f"{replica}#{v}".encode())
+            i = bisect.bisect(self._hashes, h)
+            self._hashes.insert(i, h)
+            self._owners.insert(i, replica)
+
+    def remove(self, replica: str) -> None:
+        if replica not in self._members:
+            return
+        self._members.discard(replica)
+        keep = [(h, o) for h, o in zip(self._hashes, self._owners)
+                if o != replica]
+        self._hashes = [h for h, _ in keep]
+        self._owners = [o for _, o in keep]
+
+    def owner(self, round: int) -> Optional[str]:
+        """The replica owning `round`, or None for an empty ring."""
+        if not self._hashes:
+            return None
+        h = _point(b"round:%d" % round)
+        i = bisect.bisect(self._hashes, h)
+        if i == len(self._hashes):       # wrap past the last point
+            i = 0
+        return self._owners[i]
+
+
+#: async forward(owner_id, req, timeout, client) -> serve.VerifyResult
+Forwarder = Callable[[str, object, Optional[float], Optional[str]],
+                     Awaitable[object]]
+
+
+class ReplicaRing:
+    """One gateway replica's view of the ring + its forwarding policy.
+
+    Failure accounting is per-peer and CONSECUTIVE: any successful
+    forward resets the strike count; `fail_evict` transport failures in
+    a row evict the peer from this replica's ring view (its rounds are
+    re-owned by the survivors).  An owner that answers with a shed
+    (Overloaded and friends) is alive — it never accrues strikes.
+    """
+
+    def __init__(self, self_id: str, peers: Sequence[str] = (), *,
+                 forward: Optional[Forwarder] = None, vnodes: int = 64,
+                 fail_evict: int = 3):
+        if fail_evict < 1:
+            raise ValueError("fail_evict must be >= 1")
+        self.self_id = self_id
+        self.ring = HashRing([self_id, *peers], vnodes=vnodes)
+        self._forward = forward
+        self.fail_evict = fail_evict
+        self._strikes: Dict[str, int] = {}
+        self._evicted: List[str] = []
+        self._lock = threading.Lock()
+        # per-view counters for stats()/loadgen (the module counters are
+        # process-wide and shared by every replica in one process)
+        self.forwarded = 0
+        self.forward_failures = 0
+        self.local_fallbacks = 0
+
+    # -- ownership ---------------------------------------------------------
+
+    def owner(self, round: int) -> str:
+        own = self.ring.owner(round)
+        return self.self_id if own is None else own
+
+    def owns(self, round: int) -> bool:
+        return self.owner(round) == self.self_id
+
+    # -- forwarding --------------------------------------------------------
+
+    @property
+    def can_forward(self) -> bool:
+        return self._forward is not None
+
+    async def forward(self, owner: str, req, timeout, client):
+        """One forward attempt to `owner`; raises whatever the transport
+        or the remote gateway raises.  Callers decide the fallback."""
+        if self._forward is None:
+            raise RuntimeError("ring has no forwarder configured")
+        self.forwarded += 1
+        _forwarded.inc()
+        return await self._forward(owner, req, timeout, client)
+
+    def note_alive(self, peer: str) -> None:
+        with self._lock:
+            self._strikes.pop(peer, None)
+
+    def note_failure(self, peer: str) -> None:
+        """One transport failure; evict the peer at `fail_evict`
+        consecutive strikes so its rounds re-home to live replicas."""
+        self.forward_failures += 1
+        _forward_failures.inc()
+        with self._lock:
+            strikes = self._strikes.get(peer, 0) + 1
+            self._strikes[peer] = strikes
+            if strikes >= self.fail_evict and peer in self.ring:
+                self.ring.remove(peer)
+                self._evicted.append(peer)
+                _evicted.inc()
+                log.warning("ring peer evicted after repeated forward "
+                            "failures; its rounds re-owned locally",
+                            peer=peer, strikes=strikes)
+
+    def note_local_fallback(self) -> None:
+        self.local_fallbacks += 1
+        _local_fallback.inc()
+
+    def stats(self) -> dict:
+        """Ring topology + forwarding counters for /v1/status."""
+        return {
+            "self": self.self_id,
+            "replicas": self.ring.members(),
+            "evicted": list(self._evicted),
+            "forwarded": self.forwarded,
+            "forward_failures": self.forward_failures,
+            "local_fallbacks": self.local_fallbacks,
+        }
+
+
+def inprocess_forwarder(replicas: Dict[str, object]) -> Forwarder:
+    """Forward by direct await on a sibling gateway in this process —
+    the loadgen / chaos-scenario transport.  `replicas` maps replica id
+    -> VerifyGateway (a closed gateway raises GatewayClosed like a dead
+    network peer would)."""
+
+    async def forward(owner, req, timeout, client):
+        import dataclasses
+
+        from drand_tpu.serve import gateway as gw_mod
+
+        gw = replicas.get(owner)
+        if gw is None:
+            raise gw_mod.GatewayClosed(f"no such replica {owner!r}")
+        res = await gw.verify(req, timeout, client=client, forwarded=True)
+        return dataclasses.replace(res, forwarded=True)
+
+    return forward
+
+
+def grpc_forwarder(client, *, tls: bool = False) -> Forwarder:
+    """Forward over the existing gRPC public API (`VerifyBeacon`),
+    mapping the peer's explicit shed codes back onto GatewayErrors so
+    the caller can tell "owner alive but shedding" (serve locally, no
+    eviction strike) from "owner unreachable" (strike)."""
+
+    async def forward(owner, req, timeout, fwd_client):
+        import grpc
+
+        from drand_tpu.key.keys import Identity
+        from drand_tpu.serve import gateway as gw_mod
+
+        peer = Identity(address=owner, key=None, tls=tls)
+        try:
+            resp = await client.verify_beacon(
+                peer, round=req.round, prev_round=req.prev_round,
+                prev_sig=req.prev_sig, signature=req.signature,
+                timeout=timeout, forwarded=True,
+            )
+        except grpc.aio.AioRpcError as exc:
+            code = exc.code()
+            if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                raise gw_mod.Overloaded(exc.details()) from None
+            if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise gw_mod.DeadlineExceeded(exc.details()) from None
+            if code == grpc.StatusCode.INVALID_ARGUMENT:
+                raise gw_mod.Oversize(0, 0) from None
+            raise  # UNAVAILABLE etc.: a transport failure -> strike
+        return gw_mod.VerifyResult(
+            valid=resp.valid, cached=resp.cached,
+            batch_size=resp.batch_size, forwarded=True,
+        )
+
+    return forward

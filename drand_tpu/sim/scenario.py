@@ -270,4 +270,10 @@ def run_scenario(scenario, seed: int = 1,
         from drand_tpu.sim.scenarios import get_scenario
         scenario = get_scenario(scenario)
     scenario = scenario.overridden(nodes=nodes, rounds=rounds)
+    # self-running scenarios (e.g. the gateway-replica chaos script)
+    # exercise subsystems other than SimWorld but return the same
+    # SimReport shape; the registry and CLI treat them uniformly
+    runner = getattr(scenario, "run", None)
+    if runner is not None:
+        return asyncio.run(runner(seed))
     return asyncio.run(_run(scenario, seed))

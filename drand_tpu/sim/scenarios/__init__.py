@@ -19,6 +19,7 @@ from drand_tpu.sim.scenarios import (  # noqa: E402
     crash_restart,
     device_fault,
     fork_stall,
+    gateway_kill,
     lossy_link,
     partition,
 )
@@ -26,6 +27,7 @@ from drand_tpu.sim.scenarios import (  # noqa: E402
 _MODULES = (
     partition, asym_link, clock_skew, crash_restart, byz_liar,
     byz_stale, byz_equivocate, device_fault, lossy_link, fork_stall,
+    gateway_kill,
 )
 
 SCENARIOS: Dict[str, object] = {m.build().name: m.build for m in _MODULES}

@@ -1,0 +1,192 @@
+"""Chaos: kill one verification-gateway replica mid-load.
+
+The replica ring's failure story, scripted (per ROADMAP: every new
+policy lands with a scenario): three gateway replicas share a
+consistent-hash ring over round numbers; mid-load the owner of the
+hottest rounds dies.  Survivors' forwards to it fail, strike it out
+(`fail_evict` consecutive transport failures), and evict it from their
+ring views — after which every round it owned is re-owned CONSISTENTLY
+by the survivors and traffic keeps flowing with bounded shed.
+
+This scenario drives `serve/` directly rather than `sim.harness`'s
+beacon network (the gateway is a read-path subsystem with no rounds of
+its own), so it carries its own `run()`; `sim.scenario.run_scenario`
+dispatches on that and the report shape is the standard `SimReport`.
+Verification is instant here — the chaos under test is topology, not
+kernel timing, and sleeping schemes would only add wall-clock noise.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, replace
+from typing import List, Optional
+
+from drand_tpu.sim.scenario import SimReport
+
+
+class _InstantScheme:
+    """Verdict = signature[0] == 1, no simulated dispatch cost."""
+
+    def verify_chain_batch(self, pub, msgs, sigs) -> List[bool]:
+        return [len(s) > 0 and s[0] == 1 for s in sigs]
+
+
+@dataclass
+class GatewayScenario:
+    name: str = "gateway_kill"
+    summary: str = ("kill a gateway replica mid-load; the ring re-owns "
+                    "its rounds, shed stays bounded")
+    expect_stall: bool = False
+    fixed_topology: bool = True
+    replicas: int = 3
+    #: round-number space the workload draws from
+    rounds: int = 64
+    #: requests per phase (before / after the kill)
+    requests: int = 900
+    clients: int = 32
+    #: acceptable shed fraction in the post-kill phase
+    max_shed_frac: float = 0.05
+
+    def overridden(self, nodes: Optional[int] = None,
+                   rounds: Optional[int] = None) -> "GatewayScenario":
+        if nodes is not None and nodes != self.replicas:
+            raise ValueError(
+                f"scenario {self.name} has a fixed topology of "
+                f"{self.replicas} gateway replicas")
+        scn = self
+        if rounds is not None and rounds != scn.rounds:
+            scn = replace(scn, rounds=rounds)
+        return scn
+
+    async def run(self, seed: int) -> SimReport:
+        import asyncio
+
+        from drand_tpu.serve import gateway as gw_mod
+        from drand_tpu.serve.gateway import VerifyGateway, VerifyRequest
+        from drand_tpu.serve.ring import ReplicaRing, inprocess_forwarder
+
+        ids = [f"gw-{i}" for i in range(self.replicas)]
+        pool = {}
+        forward = inprocess_forwarder(pool)
+        rings = {}
+        for rid in ids:
+            rings[rid] = ReplicaRing(
+                rid, [p for p in ids if p != rid], forward=forward)
+            pool[rid] = VerifyGateway(
+                object(), _InstantScheme(), max_batch=64,
+                max_wait=0.001, max_queue=4096, ring=rings[rid])
+        for gw in pool.values():
+            await gw.start()
+
+        def claim(r: int) -> VerifyRequest:
+            return VerifyRequest(
+                round=r, prev_round=r - 1, prev_sig=b"\x01" * 96,
+                signature=bytes([1]) + r.to_bytes(8, "big"))
+
+        events: List[dict] = []
+        failures: List[str] = []
+        served = {rid: 0 for rid in ids}
+        shed = {"before": 0, "after": 0}
+
+        async def drive(phase: str, targets: List[str], rng) -> None:
+            jobs: "asyncio.Queue" = asyncio.Queue()
+            for _ in range(self.requests):
+                jobs.put_nowait(claim(rng.randrange(1, self.rounds + 1)))
+
+            async def client(cid: int):
+                while True:
+                    try:
+                        req = jobs.get_nowait()
+                    except asyncio.QueueEmpty:
+                        return
+                    rid = targets[rng.randrange(len(targets))]
+                    try:
+                        res = await pool[rid].verify(
+                            req, timeout=30.0, client=f"c{cid}")
+                    except gw_mod.GatewayError:
+                        shed[phase] += 1
+                    else:
+                        served[rid] += 1
+                        if not res.valid:
+                            failures.append(
+                                f"{phase}: round {req.round} verdict "
+                                f"flipped invalid on {rid}")
+
+            await asyncio.gather(
+                *(client(c) for c in range(self.clients)))
+
+        rng = random.Random(seed)
+        # phase 1: healthy ring, all replicas take traffic
+        await drive("before", ids, rng)
+
+        # the victim: whoever owns round 1 — a round every replica can
+        # name identically (stable-assignment property of the ring)
+        victim = rings[ids[0]].owner(1)
+        owners_before = {
+            r: rings[ids[0]].owner(r)
+            for r in range(1, self.rounds + 1)}
+        victim_rounds = sorted(
+            r for r, o in owners_before.items() if o == victim)
+        events.append({"event": "kill", "replica": victim,
+                       "owned_rounds": len(victim_rounds)})
+        await pool[victim].close()
+        survivors = [rid for rid in ids if rid != victim]
+
+        # phase 2: clients only reach survivors (a dead replica accepts
+        # no connections); forwards to the victim fail, strike, evict
+        await drive("after", survivors, rng)
+
+        # -- expectations --------------------------------------------------
+        for rid in survivors:
+            if victim in rings[rid].ring:
+                failures.append(
+                    f"{rid} never evicted dead replica {victim}")
+        for r in victim_rounds:
+            owners = {rings[rid].owner(r) for rid in survivors}
+            if victim in owners:
+                failures.append(
+                    f"round {r} still owned by dead {victim}")
+            if len(owners) != 1:
+                failures.append(
+                    f"survivors disagree on round {r} owner: "
+                    f"{sorted(owners)}")
+        kept = [r for r in range(1, self.rounds + 1)
+                if owners_before[r] != victim
+                and rings[survivors[0]].owner(r) != owners_before[r]]
+        if kept:
+            failures.append(
+                f"minimal-movement violated: surviving owners moved "
+                f"for rounds {kept[:8]}")
+        frac = shed["after"] / max(self.requests, 1)
+        if frac > self.max_shed_frac:
+            failures.append(
+                f"post-kill shed {frac:.1%} exceeds bound "
+                f"{self.max_shed_frac:.0%}")
+
+        ring_stats = {rid: rings[rid].stats() for rid in survivors}
+        events.append({
+            "event": "post_kill",
+            "victim": victim,
+            "survivor_rings": {
+                rid: s["replicas"] for rid, s in ring_stats.items()},
+            "evicted": {
+                rid: s["evicted"] for rid, s in ring_stats.items()},
+            "shed": dict(shed),
+            "requests_per_phase": self.requests,
+        })
+
+        for gw in pool.values():
+            await gw.close()
+
+        return SimReport(
+            scenario=self.name, seed=seed, passed=not failures,
+            failures=failures, violations=[], stalled=False,
+            heads=dict(served), doctor={},
+            event_log=json.dumps(events, indent=2, sort_keys=True),
+        )
+
+
+def build() -> GatewayScenario:
+    return GatewayScenario()

@@ -16,6 +16,7 @@ import pytest
 REPO = pathlib.Path(__file__).resolve().parent.parent
 JSONL = REPO / "bench_matrix.jsonl"
 LOG = REPO / "bench_matrix.jsonl.log"
+MESH_LOADGEN = REPO / "loadgen_mesh_gateway.json"
 
 
 @pytest.mark.skipif(not JSONL.exists(), reason="no committed bench matrix")
@@ -43,3 +44,40 @@ def test_bench_matrix_rows_are_complete():
             assert "rc" in row, row  # failures carry their exit code
             continue
         assert {"metric", "value", "unit", "detail"} <= row.keys(), row
+
+
+@pytest.mark.skipif(not MESH_LOADGEN.exists(),
+                    reason="no committed mesh loadgen artifact")
+def test_mesh_loadgen_artifact_meets_acceptance_gates():
+    """The committed mesh-gateway proof-under-load artifact must carry
+    the provenance fields operators need (backend, device/replica
+    counts, degraded flag) and satisfy the PR's acceptance gates:
+    >=4x flush-throughput scaling at equal batch budget, >=90%
+    distributed-cache hit rate across >=2 replicas, and explicit shed
+    with zero deadline-blown successes at ~10x overload."""
+    doc = json.loads(MESH_LOADGEN.read_text())
+    # provenance: a CPU/sim run can never masquerade as TPU numbers
+    assert doc["benchmark"] == "serve-mesh-gateway"
+    assert isinstance(doc["backend"], str) and doc["backend"]
+    assert doc["devices"] >= 2
+    assert doc["replicas"] >= 2
+    assert doc["degraded"] is False
+    assert doc["mesh_backend"] == doc["mesh_scaling"]["mesh"]["mesh_backend"]
+
+    scaling = doc["mesh_scaling"]
+    assert scaling["single"]["devices"] == 1
+    assert scaling["mesh"]["devices"] == doc["devices"]
+    # equal batch budget on both sides of the comparison
+    assert scaling["single"]["flush_items"] == scaling["mesh"]["flush_items"]
+    assert scaling["scaling_x"] >= 4.0, scaling
+
+    hot = doc["hot_round"]
+    assert hot["replicas"] >= 2
+    assert hot["hit_rate"] >= 0.90, hot
+    assert hot["valid"] == hot["requests"]  # nothing lost while routing
+
+    over = doc["overload"]
+    assert over["overload_factor"] >= 8.0, over
+    assert over["shed_queue_full"] + over["shed_deadline"] > 0
+    assert over["deadline_blown_successes"] == 0, over
+    assert over["served"] > 0  # shed is load-shedding, not an outage

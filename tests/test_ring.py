@@ -1,0 +1,291 @@
+"""serve/ring.py: consistent-hash ownership properties + the gateway's
+forwarding policy over it.
+
+The two ring properties the distributed cache depends on are pinned as
+property-style tests over many rounds: STABLE assignment (same members
+-> same owner map, regardless of construction order or process) and
+MINIMAL movement (a membership change moves only the joining/leaving
+replica's rounds).  The gateway-side tests drive two in-process
+replicas and check forward-once, local-fallback-on-failure, and
+failure-driven eviction — the "never a hard dependency" contract.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+import pytest
+
+from drand_tpu.serve import (
+    HashRing,
+    ReplicaRing,
+    VerifyGateway,
+    VerifyRequest,
+    inprocess_forwarder,
+)
+from drand_tpu.serve.ring import _point
+
+ROUNDS = range(1, 601)
+
+
+def owner_map(ring: HashRing) -> dict:
+    return {r: ring.owner(r) for r in ROUNDS}
+
+
+# -- HashRing properties ----------------------------------------------------
+
+
+def test_stable_assignment_any_construction_order():
+    a = HashRing(["alpha", "beta", "gamma"])
+    b = HashRing(["gamma", "alpha", "beta"])
+    c = HashRing()
+    for m in ("beta", "gamma", "alpha"):
+        c.add(m)
+    assert owner_map(a) == owner_map(b) == owner_map(c)
+
+
+def test_point_is_process_independent():
+    """Ring positions come from SHA-256, not hash() — a peer in another
+    process (different PYTHONHASHSEED) must compute the same ring."""
+    assert _point(b"round:42") == int.from_bytes(
+        __import__("hashlib").sha256(b"round:42").digest()[:8], "big")
+
+
+def test_minimal_movement_on_leave():
+    ring = HashRing(["alpha", "beta", "gamma"])
+    before = owner_map(ring)
+    ring.remove("beta")
+    after = owner_map(ring)
+    moved = {r for r in ROUNDS if before[r] != after[r]}
+    assert moved == {r for r in ROUNDS if before[r] == "beta"}
+    assert all(after[r] != "beta" for r in ROUNDS)
+
+
+def test_minimal_movement_on_join():
+    ring = HashRing(["alpha", "beta"])
+    before = owner_map(ring)
+    ring.add("gamma")
+    after = owner_map(ring)
+    moved = {r for r in ROUNDS if before[r] != after[r]}
+    assert moved  # the newcomer takes a share...
+    assert all(after[r] == "gamma" for r in moved)  # ...and ONLY it
+
+
+def test_ownership_roughly_balanced():
+    ring = HashRing(["alpha", "beta", "gamma"], vnodes=64)
+    counts = {m: 0 for m in ring.members()}
+    for r in ROUNDS:
+        counts[ring.owner(r)] += 1
+    # vnodes smooth the split; each member owns a real share
+    assert all(c > len(ROUNDS) * 0.15 for c in counts.values()), counts
+
+
+def test_empty_and_membership_api():
+    ring = HashRing()
+    assert ring.owner(1) is None and len(ring) == 0
+    ring.add("alpha")
+    ring.add("alpha")  # idempotent
+    assert len(ring) == 1 and "alpha" in ring
+    assert ring.owner(123) == "alpha"
+    ring.remove("nope")  # unknown member: no-op
+    assert ring.members() == ["alpha"]
+
+
+def test_replica_ring_eviction_after_consecutive_strikes():
+    ring = ReplicaRing("alpha", ["beta"], fail_evict=3)
+    ring.note_failure("beta")
+    ring.note_failure("beta")
+    ring.note_alive("beta")      # success resets the strike count
+    ring.note_failure("beta")
+    ring.note_failure("beta")
+    assert "beta" in ring.ring
+    ring.note_failure("beta")    # third CONSECUTIVE strike
+    assert "beta" not in ring.ring
+    assert ring.stats()["evicted"] == ["beta"]
+    # every round the dead peer owned re-homes to the survivor
+    assert all(ring.owner(r) == "alpha" for r in ROUNDS)
+
+
+# -- gateway forwarding over the ring ---------------------------------------
+
+
+class StubScheme:
+    def __init__(self, gate: threading.Event = None):
+        self.batches = []
+        self.gate = gate
+
+    def verify_chain_batch(self, pub, msgs, sigs):
+        if self.gate is not None:
+            assert self.gate.wait(10.0), "test gate never released"
+        self.batches.append(list(msgs))
+        return [sig.startswith(b"ok") for sig in sigs]
+
+    @property
+    def seen(self):
+        return [m for b in self.batches for m in b]
+
+
+def req(round: int) -> VerifyRequest:
+    return VerifyRequest(round=round, prev_round=round - 1,
+                         prev_sig=b"\x01" * 96,
+                         signature=b"ok" + round.to_bytes(8, "big"))
+
+
+def two_replicas(b_gate: threading.Event = None, b_max_queue: int = 1024):
+    pool = {}
+    forward = inprocess_forwarder(pool)
+    schemes = {}
+    for rid in ("a", "b"):
+        ring = ReplicaRing(rid, [p for p in ("a", "b") if p != rid],
+                           forward=forward)
+        schemes[rid] = StubScheme(b_gate if rid == "b" else None)
+        pool[rid] = VerifyGateway(
+            object(), schemes[rid], max_wait=0.005, ring=ring,
+            max_queue=(b_max_queue if rid == "b" else 1024))
+    return pool, schemes
+
+
+def round_owned_by(ring: ReplicaRing, owner: str) -> int:
+    return next(r for r in range(1, 200) if ring.owner(r) == owner)
+
+
+async def test_off_owner_request_forwards_once_to_owner():
+    pool, schemes = two_replicas()
+    async with pool["a"], pool["b"]:
+        r = round_owned_by(pool["a"].ring, "b")
+        res = await pool["a"].verify(req(r))
+        assert res.valid and res.forwarded
+        assert schemes["b"].seen == [req(r).message()]  # owner verified
+        assert schemes["a"].seen == []                  # origin did not
+        assert pool["a"].ring.stats()["forwarded"] == 1
+        # the owner serves its OWN rounds locally, no forward
+        own = round_owned_by(pool["a"].ring, "a")
+        res = await pool["a"].verify(req(own))
+        assert res.valid and not res.forwarded
+        assert pool["a"].ring.stats()["forwarded"] == 1
+
+
+async def test_distributed_cache_hits_via_owner():
+    pool, schemes = two_replicas()
+    async with pool["a"], pool["b"]:
+        r = round_owned_by(pool["a"].ring, "b")
+        first = await pool["a"].verify(req(r))
+        assert not first.cached
+        # the SAME round from either replica now hits the owner's cache
+        again = await pool["a"].verify(req(r))
+        direct = await pool["b"].verify(req(r))
+        assert again.cached and again.forwarded
+        assert direct.cached and not direct.forwarded
+        assert schemes["b"].seen == [req(r).message()]  # one kernel row
+
+
+async def test_forwarded_marker_prevents_reforwarding():
+    """A request already forwarded once is served locally even by a
+    non-owner — a stale ring view must not create routing loops."""
+    pool, schemes = two_replicas()
+    async with pool["a"], pool["b"]:
+        r = round_owned_by(pool["a"].ring, "b")
+        res = await pool["a"].verify(req(r), forwarded=True)
+        assert res.valid
+        assert schemes["a"].seen == [req(r).message()]  # served HERE
+        assert pool["a"].ring.stats()["forwarded"] == 0
+
+
+async def test_dead_owner_falls_back_local_then_evicts():
+    pool, schemes = two_replicas()
+    ring_a = pool["a"].ring
+    async with pool["a"]:
+        # "b" is down: a closed gateway raises like a dead peer would
+        await pool["b"].start()
+        await pool["b"].close()
+        rounds = [r for r in range(1, 300)
+                  if ring_a.owner(r) == "b"][:ring_a.fail_evict]
+        for r in rounds:
+            res = await pool["a"].verify(req(r))
+            assert res.valid and not res.forwarded  # served locally
+        stats = ring_a.stats()
+        assert stats["forward_failures"] == ring_a.fail_evict
+        assert stats["local_fallbacks"] == ring_a.fail_evict
+        assert "b" not in ring_a.ring  # evicted; rounds re-owned
+        assert all(ring_a.owner(r) == "a" for r in rounds)
+        # no strikes left to pay: nothing ever tries "b" again
+        fails = ring_a.forwarded
+        res = await pool["a"].verify(req(10_000))
+        assert res.valid
+        assert ring_a.forwarded == fails
+
+
+async def test_shedding_owner_is_alive_not_struck():
+    """An owner answering with an explicit shed is ALIVE: the origin
+    serves locally but must not strike (much less evict) it."""
+    gate = threading.Event()
+    pool, schemes = two_replicas(b_gate=gate, b_max_queue=1)
+    try:
+        async with pool["a"], pool["b"]:
+            ring_a = pool["a"].ring
+            # wedge b: one batch blocked inside the kernel, queue full
+            blocked = asyncio.ensure_future(pool["b"].verify(req(5000)))
+            await asyncio.sleep(0.05)
+            filler = asyncio.ensure_future(pool["b"].verify(req(5001)))
+            await asyncio.sleep(0)
+            rounds = [r for r in range(1, 300)
+                      if ring_a.owner(r) == "b"][:ring_a.fail_evict + 1]
+            for r in rounds:
+                res = await pool["a"].verify(req(r))
+                assert res.valid and not res.forwarded  # local fallback
+            assert "b" in ring_a.ring  # alive: never evicted
+            assert ring_a.stats()["forward_failures"] == 0
+            assert ring_a.stats()["local_fallbacks"] == len(rounds)
+            gate.set()
+            assert (await blocked).valid and (await filler).valid
+    finally:
+        gate.set()
+
+
+async def test_status_surfaces_ring_and_mesh():
+    pool, _ = two_replicas()
+    async with pool["a"]:
+        stats = pool["a"].stats()
+        assert stats["ring"]["self"] == "a"
+        assert stats["ring"]["replicas"] == ["a", "b"]
+        assert stats["mesh"] == {"devices": 1, "backend": None,
+                                 "sharded_batches": 0}
+    # no ring configured -> explicit null, not a missing key
+    async with VerifyGateway(object(), StubScheme()) as gw:
+        assert gw.stats()["ring"] is None
+
+
+# -- cache under concurrent access (satellite: stream-demux path) -----------
+
+
+def test_cache_concurrent_hit_miss_evict_threads():
+    """The LRU is read from the event loop and written from executor
+    completions: hammer hit/add/len/contains from 8 threads and require
+    no exception and an intact capacity bound."""
+    from drand_tpu.serve import VerifiedRoundCache
+
+    cache = VerifiedRoundCache(capacity=64)
+    errors = []
+    start = threading.Barrier(8)
+
+    def worker(tid: int):
+        try:
+            start.wait(5.0)
+            for i in range(3000):
+                key = (tid % 4, i % 96)  # overlapping key space
+                if not cache.hit(key):
+                    cache.add(key)
+                assert len(cache) <= 64
+                (tid, "never-added") in cache
+        except Exception as exc:  # noqa: BLE001 — collected for assert
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert not errors
+    assert 0 < len(cache) <= 64

@@ -211,7 +211,125 @@ async def test_closed_gateway_refuses():
         await gw.verify(req(1))
 
 
+# -- mesh-sharded scheduler -------------------------------------------------
+
+
+class StubMeshScheme(StubScheme):
+    """Mesh-capable stub: records per-device lane shapes so tests can
+    assert the flush was dealt and dispatched as ONE mesh program."""
+
+    def __init__(self, gate: threading.Event = None):
+        super().__init__(gate)
+        self.mesh_lanes = []
+        self.devices = 0
+
+    def configure_mesh(self, n_devices: int) -> str:
+        self.devices = n_devices
+        return "stub"
+
+    def verify_chain_batch_mesh(self, pub, lane_msgs, lane_sigs):
+        if self.gate is not None:
+            assert self.gate.wait(10.0), "test gate never released"
+        self.mesh_lanes.append([len(lane) for lane in lane_msgs])
+        self.batches.append([m for lane in lane_msgs for m in lane])
+        return [[sig.startswith(b"ok") for sig in lane]
+                for lane in lane_sigs]
+
+
+async def test_mesh_flush_is_one_sharded_dispatch():
+    """With mesh_devices=N a flush deals its items into N balanced
+    lanes and dispatches ONE mesh program; verdicts demux per caller
+    exactly like the single-device path."""
+    scheme = StubMeshScheme()
+    async with gateway(scheme, max_batch=64, mesh_devices=4) as gw:
+        reqs = [req(r, valid=(r % 3 != 0)) for r in range(1, 23)]
+        results = await asyncio.gather(*(gw.verify(r) for r in reqs))
+        for r, res in zip(reqs, results):
+            assert res.valid == (r.round % 3 != 0), r
+        assert scheme.devices == 4  # configure_mesh ran at start
+        assert scheme.mesh_lanes, "mesh path never dispatched"
+        for lanes in scheme.mesh_lanes:
+            assert len(lanes) == 4
+            # round-robin deal: lanes within one item of each other
+            assert max(lanes) - min(lanes) <= 1
+        assert sorted(scheme.seen) == sorted(r.message() for r in reqs)
+        stats = gw.stats()
+        assert stats["mesh"]["devices"] == 4
+        assert stats["mesh"]["backend"] == "stub"
+        assert stats["mesh"]["sharded_batches"] == len(scheme.mesh_lanes)
+        assert stats["flush_items"] == len(reqs)
+        assert stats["flush_seconds"] > 0
+
+
+async def test_mesh_requires_scheme_support_else_single_device():
+    """A scheme without verify_chain_batch_mesh degrades to the default
+    single-device scheduler instead of failing mid-flush."""
+    scheme = StubScheme()
+    async with gateway(scheme, mesh_devices=4) as gw:
+        assert gw.mesh_devices == 1
+        res = await gw.verify(req(1))
+        assert res.valid
+        assert gw.stats()["mesh"] == {"devices": 1, "backend": None,
+                                      "sharded_batches": 0}
+
+
+def test_assemble_lanes_round_robin():
+    from drand_tpu.serve import assemble_lanes
+    from drand_tpu.serve.batcher import BatchItem
+
+    items = [BatchItem(payload=i) for i in range(10)]
+    lanes = assemble_lanes(items, 4)
+    assert [len(lane) for lane in lanes] == [3, 3, 2, 2]
+    assert [i.payload for i in lanes[0]] == [0, 4, 8]
+    # empty lanes are kept: the mesh program shape is fixed
+    lanes = assemble_lanes(items[:2], 4)
+    assert [len(lane) for lane in lanes] == [1, 1, 0, 0]
+    assert assemble_lanes([], 3) == [[], [], []]
+    with pytest.raises(ValueError):
+        assemble_lanes(items, 0)
+
+
 # -- scheduler unit behaviour ----------------------------------------------
+
+
+async def test_batch_item_from_worker_thread_binds_running_loop():
+    """Regression: BatchItem's old default factory called
+    asyncio.get_event_loop() at CONSTRUCTION time, so an item built on
+    a worker thread carried a future of a loop that never resolves it.
+    Now the future stays None until submit() binds the running loop."""
+    from drand_tpu.serve.batcher import BatchItem
+
+    done = []
+
+    async def flush(items):
+        for item in items:
+            item.future.set_result("ok")
+            done.append(item)
+
+    built = []
+
+    def build_off_loop():
+        # no running loop in this thread; must neither raise nor bind
+        built.append(BatchItem(payload="from-thread"))
+
+    t = threading.Thread(target=build_off_loop)
+    t.start()
+    t.join(5.0)
+    (item,) = built
+    assert item.future is None
+
+    sched = BatchScheduler(flush, max_wait=0.001)
+    sched.start()
+    try:
+        sched.submit(item)
+        assert item.future is not None
+        assert item.future.get_loop() is asyncio.get_running_loop()
+        assert await item.future == "ok"
+    finally:
+        await sched.close()
+
+
+# -- legacy scheduler unit behaviour ----------------------------------------
 
 
 async def test_scheduler_flush_error_fails_batch_not_loop():

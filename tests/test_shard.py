@@ -125,6 +125,50 @@ def test_sharded_msm_matches_unsharded(ndev, t):
     assert sharded == unsharded == want
 
 
+def test_mesh_chain_verify_matches_single_device(mesh):
+    """The gateway's mesh flush path (`JaxScheme.verify_chain_batch_mesh`)
+    must agree verdict-for-verdict with the single-device
+    `verify_chain_batch` it shards — including uneven lanes, empty
+    lanes, identity-encoded garbage, and wrong-message signatures."""
+    from drand_tpu.crypto import tbls
+
+    sk = 0xC0FFEE % ref.R
+    pk = ref.g1_mul(ref.G1_GEN, sk)
+
+    msgs = [b"mesh-round-%d" % i for i in range(11)]
+    sigs = [ref.g2_to_bytes(ref.g2_mul(ref.hash_to_g2(m), sk))
+            for m in msgs]
+    sigs[2] = sigs[3]          # wrong-message signature
+    sigs[7] = b"\x00" * 192    # malformed: rejected at parse
+
+    scheme = tbls.JaxScheme()
+    want = scheme.verify_chain_batch(pk, msgs, sigs)
+    assert want == [i not in (2, 7) for i in range(11)]
+
+    backend = scheme.configure_mesh(N_DEV)
+    assert backend == mesh.devices.flat[0].platform
+
+    # deal 11 items over 8 lanes round-robin (lanes 0-2 get 2, rest 1),
+    # then empty two lanes entirely to hit the fallback-row path
+    lanes_m = [[] for _ in range(N_DEV)]
+    lanes_s = [[] for _ in range(N_DEV)]
+    for i, (m, s) in enumerate(zip(msgs, sigs)):
+        lanes_m[i % N_DEV].append(m)
+        lanes_s[i % N_DEV].append(s)
+    lanes_m[5], lanes_s[5] = [], []
+    got = scheme.verify_chain_batch_mesh(pk, lanes_m, lanes_s)
+    assert [len(lane) for lane in got] == [len(l) for l in lanes_m]
+    flat = {}
+    for lm, lv in zip(lanes_m, got):
+        flat.update(zip(lm, lv))
+    for i, m in enumerate(msgs):
+        if m in flat:
+            assert flat[m] == want[i], (i, m)
+
+    with pytest.raises(ValueError):
+        scheme.verify_chain_batch_mesh(pk, lanes_m[:4], lanes_s[:4])
+
+
 def test_sharded_msm_replication(mesh):
     """The production shard_map runs with check_vma=False and
     out_specs=P() — an unverified replication claim.  Run the SAME body

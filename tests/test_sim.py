@@ -129,6 +129,32 @@ def test_cli_sim_list_and_json_report(tmp_path):
     assert any(e["kind"] == "fault_event" for e in events)
 
 
+def test_gateway_kill_scenario_reowns_and_bounds_shed():
+    """Chaos for the replica ring (this PR's subsystem): kill one of
+    three gateway replicas mid-load.  Survivors must strike it out and
+    evict it, every round it owned must re-home consistently, untouched
+    rounds must not move, and post-kill shed stays within the bound."""
+    report = run_scenario("gateway_kill", seed=1)
+    assert report.passed, (report.failures, report.heads)
+    assert not report.stalled and not report.violations
+    events = json.loads(report.event_log)
+    kill = next(e for e in events if e["event"] == "kill")
+    post = next(e for e in events if e["event"] == "post_kill")
+    victim = kill["replica"]
+    assert kill["owned_rounds"] > 0
+    # every survivor's ring view dropped the victim
+    for rid, members in post["survivor_rings"].items():
+        assert victim not in members, (rid, members)
+        assert post["evicted"][rid] == [victim]
+    # traffic flowed on both sides of the kill
+    assert sum(report.heads.values()) > 0
+    assert report.heads[victim] > 0  # took load before dying
+    # fixed topology: --nodes overrides are refused, rounds scale
+    with pytest.raises(ValueError, match="fixed topology"):
+        get_scenario("gateway_kill").overridden(nodes=5)
+    assert get_scenario("gateway_kill").overridden(rounds=32).rounds == 32
+
+
 def test_scenario_registry_and_overrides():
     assert set(REQUIRED_SCENARIOS) <= set(SCENARIOS)
     assert len(SCENARIOS) >= 7
