@@ -329,7 +329,10 @@ class BeaconHandler:
         # its partials still queued behind the loop.
         # (asyncio.to_thread copies the contextvars context, so kernel
         # spans opened inside the scheme parent to the stage span.)
-        with obs_trace.TRACER.span("beacon.sign", attrs={"round": round}):
+        with obs_trace.TRACER.span(
+            "beacon.sign",
+            attrs={"round": round, "node": self.cfg.public.address},
+        ):
             own = await self._offload(
                 self.scheme.partial_sign, self.cfg.share.share, msg
             )
@@ -347,7 +350,8 @@ class BeaconHandler:
         )
         with obs_trace.TRACER.span(
             "beacon.gossip",
-            attrs={"round": round, "peers": len(self.group) - 1},
+            attrs={"round": round, "peers": len(self.group) - 1,
+                   "node": self.cfg.public.address},
         ):
             peers = [n for n in self.group.nodes
                      if n.address != self.cfg.public.address]
@@ -364,7 +368,8 @@ class BeaconHandler:
 
         with obs_trace.TRACER.span(
             "beacon.aggregate",
-            attrs={"round": round, "threshold": self.group.threshold},
+            attrs={"round": round, "threshold": self.group.threshold,
+                   "node": self.cfg.public.address},
         ) as agg_span:
             partials: Dict[int, bytes] = {self.index: own}
             while len(partials) < self.group.threshold:
@@ -400,8 +405,10 @@ class BeaconHandler:
         cur_head = self.store.last()
         if cur_head is not None and cur_head.round >= round:
             return
-        with obs_trace.TRACER.span("beacon.store",
-                                   attrs={"round": round}):
+        with obs_trace.TRACER.span(
+            "beacon.store",
+            attrs={"round": round, "node": self.cfg.public.address},
+        ):
             self.store.put(beacon)
         _rounds_total.inc()
         _head_gauge.set(round)
@@ -448,7 +455,8 @@ class BeaconHandler:
             with obs_trace.TRACER.span(
                 "beacon.verify",
                 attrs={"round": round, "partials": len(partials),
-                       "fused": True},
+                       "fused": True,
+                       "node": self.cfg.public.address},
             ):
                 return await self._offload(
                     self.scheme.finalize_round,
@@ -467,7 +475,8 @@ class BeaconHandler:
                 "beacon.verify",
                 attrs={"round": round, "partials": len(partials),
                        "fused": True, "optimistic": True,
-                       "attempt": attempt},
+                       "attempt": attempt,
+                       "node": self.cfg.public.address},
             ):
                 try:
                     return await self._offload(
@@ -690,7 +699,8 @@ class BeaconHandler:
                     attrs={"peer": peer.address, "batch": batch_index,
                            "size": len(batch),
                            "from_round": batch[0].round,
-                           "to_round": batch[-1].round},
+                           "to_round": batch[-1].round,
+                           "node": self.cfg.public.address},
                 ) as sync_span:
                     try:
                         head = await self._verify_and_store(head, batch)

@@ -22,6 +22,14 @@ and daemon.go/control.go/public.go):
   drand-tpu trace <round>                  span tree of one beacon round
   drand-tpu doctor                         ranked diagnosis from /v1/slo
                                            + /v1/status + /debug/flight
+  drand-tpu fleet --nodes a,b,c            aggregate N nodes into one
+                                           fleet view (GET /v1/fleet
+                                           with --serve)
+  drand-tpu watch --nodes a,b,c            third-party chain watchdog:
+                                           verify everything, report
+                                           forks/stalls/lag
+  drand-tpu sim run|list|inspect           deterministic chaos scenarios
+                                           + merged timeline viewer
 
 Run as `python -m drand_tpu.cli ...`.
 """
@@ -686,6 +694,13 @@ def diagnose(status, slo_doc, flight_events) -> list:
     return findings
 
 
+#: `doctor --json` document version: the envelope (schema/url/critical/
+#: findings) and each finding's {severity, kind, summary, detail} keys
+#: are a stable contract for CI and the fleet aggregator; additions bump
+#: the suffix, existing keys never change meaning
+DOCTOR_SCHEMA = "drand-tpu.doctor.v1"
+
+
 def cmd_doctor(args) -> int:
     """Pull the three observability documents and print the ranked
     diagnosis; exit 1 when anything critical was found."""
@@ -702,8 +717,14 @@ def cmd_doctor(args) -> int:
               if isinstance(flight_doc, dict) else flight_doc)
 
     findings = diagnose(status, slo_doc, events)
+    critical = any(f["severity"] == "critical" for f in findings)
     if args.json:
-        print(json.dumps(findings, indent=2))
+        print(json.dumps({
+            "schema": DOCTOR_SCHEMA,
+            "url": base,
+            "critical": critical,
+            "findings": findings,
+        }, indent=2, sort_keys=True))
     else:
         marks = {"critical": "!!", "warning": " !", "info": "  "}
         for f in findings:
@@ -711,7 +732,253 @@ def cmd_doctor(args) -> int:
                   f"[{f['severity']}] {f['kind']}: {f['summary']}")
             if f.get("detail"):
                 print(f"       {f['detail']}")
-    return 1 if any(f["severity"] == "critical" for f in findings) else 0
+    return 1 if critical else 0
+
+
+def _parse_node_urls(spec: str) -> dict:
+    """--nodes a,b,c -> {name: base_url}; names are the host:port part
+    so the fleet table stays readable."""
+    out = {}
+    for raw in spec.split(","):
+        url = raw.strip().rstrip("/")
+        if not url:
+            continue
+        if "://" not in url:
+            url = f"http://{url}"
+        name = url.split("://", 1)[1]
+        out[name] = url
+    if not out:
+        raise SystemExit("--nodes: no URLs given")
+    return out
+
+
+def _fetch_node_docs(urls: dict) -> dict:
+    """One synchronous poll of every node's status + SLO documents."""
+    docs = {}
+    for name, base in sorted(urls.items()):
+        try:
+            docs[name] = {
+                "status": _http_get_json(f"{base}/v1/status"),
+                "slo": _http_get_json(f"{base}/v1/slo"),
+            }
+        except Exception as exc:
+            docs[name] = {"error": str(exc)[:160]}
+    return docs
+
+
+def cmd_fleet(args) -> int:
+    """Aggregate N nodes' observability documents into one fleet view
+    (obs.fleet.aggregate): head spread, quorum margin, worst burn rate,
+    suspect consensus.  One-shot by default; --interval loops a live TTY
+    view; --serve exposes the same document at GET /v1/fleet."""
+    import json
+
+    from drand_tpu.obs.fleet import (
+        FleetAggregator,
+        aggregate,
+        render_fleet,
+    )
+
+    urls = _parse_node_urls(args.nodes)
+
+    if args.serve is not None:
+        from drand_tpu.net.rest import build_fleet_app, start_rest
+
+        def make_source(base):
+            async def source():
+                return await asyncio.to_thread(lambda: {
+                    "status": _http_get_json(f"{base}/v1/status"),
+                    "slo": _http_get_json(f"{base}/v1/slo"),
+                })
+            return source
+
+        async def serve() -> int:
+            agg = FleetAggregator(
+                {n: make_source(b) for n, b in urls.items()})
+            runner, port = await start_rest(build_fleet_app(agg),
+                                            args.serve)
+            print(f"fleet observatory on :{port} "
+                  f"({len(urls)} nodes: {', '.join(sorted(urls))})",
+                  flush=True)
+            try:
+                while True:
+                    await asyncio.sleep(3600)
+            finally:
+                await runner.cleanup()
+
+        return asyncio.run(serve())
+
+    while True:
+        doc = aggregate(_fetch_node_docs(urls))
+        if args.json:
+            print(json.dumps(doc, indent=2, sort_keys=True, default=repr))
+        else:
+            print(render_fleet(doc))
+        if not args.interval:
+            return 0
+        time.sleep(args.interval)
+        print()
+
+
+def _watch_schedule(base: str, period, genesis):
+    """Bootstrap (period, genesis_time) for the watcher from a node.
+
+    Prefer the public chain API's group document (`/api/info/group`) —
+    a third-party watcher should not need the operator plane — and fall
+    back to `/v1/status` for nodes that predate the group route."""
+    import urllib.request
+
+    try:
+        from drand_tpu.utils import parse_duration
+        from drand_tpu.utils import tomlcompat as tomllib
+
+        with urllib.request.urlopen(f"{base}/api/info/group",
+                                    timeout=10) as resp:
+            doc = tomllib.loads(resp.read().decode("utf-8"))
+        period = period or parse_duration(doc["Period"])
+        genesis = genesis or doc["GenesisTime"]
+    except Exception:
+        chain = _http_get_json(f"{base}/v1/status")["chain"]
+        period = period or chain["period"]
+        genesis = genesis or chain["genesis_time"]
+    return period, genesis
+
+
+def cmd_watch(args) -> int:
+    """Follow one or more nodes' chains as an untrusted third party
+    (obs.watch.ChainWatcher): every fetched beacon is verified against
+    the distributed key, and fork/stall/lag events print as they fire.
+
+    The distributed key comes from --distkey (hex) or, trust-on-first-
+    fetch, from the first reachable node's /api/info/distkey — fine for
+    operations against your own fleet, NOT for adversarial settings."""
+    import json
+
+    from drand_tpu.crypto import refimpl as ref
+    from drand_tpu.crypto import tbls
+    from drand_tpu.obs.watch import ChainWatcher, rest_source
+
+    urls = _parse_node_urls(args.nodes)
+
+    dist_key = None
+    if args.distkey:
+        dist_key = ref.g1_from_bytes(bytes.fromhex(args.distkey))
+        if dist_key is None:
+            print("bad --distkey: identity point", file=sys.stderr)
+            return 1
+    period, genesis = args.period, args.genesis
+    for name, base in sorted(urls.items()):
+        try:
+            if dist_key is None:
+                coeffs = _http_get_json(
+                    f"{base}/api/info/distkey")["coefficients"]
+                dist_key = ref.g1_from_bytes(bytes.fromhex(coeffs[0]))
+                print(f"# distributed key from {name} "
+                      "(trust-on-first-fetch; pass --distkey to pin)")
+            if period is None or genesis is None:
+                period, genesis = _watch_schedule(base, period, genesis)
+            break
+        except Exception as exc:
+            print(f"# bootstrap via {name} failed: {exc}",
+                  file=sys.stderr)
+    if dist_key is None or period is None or genesis is None:
+        print("no reachable node to bootstrap from; pass --distkey, "
+              "--period and --genesis", file=sys.stderr)
+        return 1
+
+    watcher = ChainWatcher(
+        dist_key, tbls.default_scheme(), period=period,
+        genesis_time=genesis,
+        sources={n: rest_source(b) for n, b in urls.items()},
+    )
+
+    async def run() -> int:
+        printed = 0
+        while True:
+            snap = await watcher.poll()
+            for ev in watcher.events[printed:]:
+                print(json.dumps(ev, sort_keys=True) if args.json
+                      else _render_watch_event(ev))
+            printed = len(watcher.events)
+            if not args.json:
+                heads = " ".join(
+                    f"{p}={v['head']}{'!' if v['status'] != 'ok' else ''}"
+                    for p, v in sorted(snap["peers"].items()))
+                print(f"\rheads: {heads}  expected={snap['expected_round']}"
+                      f"  forks={len(snap['forks'])}"
+                      f"  stalled={snap['stalled']}", flush=True)
+            if args.once:
+                return 1 if (snap["forks"] or snap["stalled"]) else 0
+            await asyncio.sleep(args.interval)
+
+    return asyncio.run(run())
+
+
+def _render_watch_event(ev: dict) -> str:
+    rest = " ".join(f"{k}={ev[k]}" for k in sorted(ev)
+                    if k not in ("kind", "ts"))
+    return f"[{ev.get('ts', 0):.0f}] {ev['kind']}: {rest}"
+
+
+def cmd_sim_inspect(args) -> int:
+    """Render a simulation event log (`sim run --out events.json`) as a
+    merged cross-node timeline: every fabric/handler/watcher/invariant
+    event on one time axis, offsets relative to genesis.  With a
+    watcher-attached run the `watch_*` and `node_span` rows interleave
+    with the nodes' own events — the time-travel debugger view of a
+    chaos scenario."""
+    import json
+
+    try:
+        with open(args.events) as f:
+            doc = json.load(f)
+        events = doc["events"] if isinstance(doc, dict) else doc
+        assert isinstance(events, list)
+    except (OSError, ValueError, KeyError, AssertionError) as exc:
+        print(f"{args.events}: not a sim event log ({exc!r})",
+              file=sys.stderr)
+        return 1
+
+    genesis = None
+    for ev in events:
+        if ev.get("kind") == "sim_start":
+            genesis = ev.get("genesis")
+            break
+
+    def _actor(ev: dict) -> str:
+        if "node" in ev:
+            return str(ev["node"])
+        if "peer" in ev:
+            return str(ev["peer"])
+        if "src" in ev and "dst" in ev:
+            return f"{ev['src']}->{ev['dst']}"
+        return "-"
+
+    def _round_of(ev: dict):
+        for key in ("round", "divergence_round"):
+            if key in ev:
+                return ev[key]
+        return None
+
+    shown = 0
+    skip = {"kind", "ts", "seq", "node", "peer", "src", "dst"}
+    for ev in events:
+        if args.round is not None and _round_of(ev) != args.round:
+            continue
+        ts = ev.get("ts", 0)
+        off = ts - genesis if genesis is not None else ts
+        star = "*" if str(ev.get("kind", "")).startswith("watch_") else " "
+        rest = " ".join(
+            f"{k}={ev[k]}" for k in sorted(ev) if k not in skip)
+        print(f"{star}{off:+10.2f}s  {_actor(ev):16s} "
+              f"{ev.get('kind', '?'):18s} {rest}")
+        shown += 1
+    label = (f"round {args.round}" if args.round is not None
+             else "all rounds")
+    print(f"-- {shown}/{len(events)} events ({label}; "
+          f"offsets relative to "
+          f"{'genesis' if genesis is not None else 'epoch'})")
+    return 0
 
 
 def cmd_sim_list(args) -> int:
@@ -737,7 +1004,8 @@ def cmd_sim_run(args) -> int:
     from drand_tpu.sim import run_scenario
 
     report = run_scenario(args.scenario, seed=args.seed,
-                          nodes=args.nodes, rounds=args.rounds)
+                          nodes=args.nodes, rounds=args.rounds,
+                          watch=args.watch)
     if args.out:
         with open(args.out, "w") as f:
             f.write(report.event_log)
@@ -750,6 +1018,15 @@ def cmd_sim_run(args) -> int:
         print(f"  heads: {heads}")
         print(f"  stalled: {report.stalled}  "
               f"violations: {len(report.violations)}")
+        if report.watch is not None:
+            w = report.watch
+            vheads = " ".join(
+                f"{p}={v['head']}" for p, v in sorted(w["peers"].items()))
+            print(f"  watcher: verified heads {vheads}  "
+                  f"stalled={w['stalled']}  forks={len(w['forks'])}")
+            for f in w["forks"]:
+                print(f"  watcher fork @ round {f['divergence_round']} "
+                      f"({f['peer']}): {f['detail']}")
         for v in report.violations:
             print(f"  violation [{v['kind']}] node={v['node']} "
                   f"round={v['round']}: {v['detail']}")
@@ -966,8 +1243,51 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--url", default="http://127.0.0.1:8080",
                    help="REST base URL of the node")
     g.add_argument("--json", action="store_true",
-                   help="print findings as a JSON list")
+                   help="machine-readable document (schema "
+                        "drand-tpu.doctor.v1); exit code is unchanged")
     g.set_defaults(fn=cmd_doctor)
+
+    g = sub.add_parser(
+        "fleet",
+        help="aggregate N nodes' status/SLO documents into one fleet "
+             "view (head spread, quorum margin, worst burn rate)",
+    )
+    g.add_argument("--nodes", required=True,
+                   help="comma-separated REST base URLs of the nodes")
+    g.add_argument("--json", action="store_true",
+                   help="print the aggregated document as JSON")
+    g.add_argument("--interval", type=float, default=0.0,
+                   help="refresh every N seconds (default: one shot)")
+    g.add_argument("--serve", type=int, metavar="PORT",
+                   help="serve the aggregate at GET /v1/fleet instead "
+                        "of printing it")
+    g.set_defaults(fn=cmd_fleet)
+
+    g = sub.add_parser(
+        "watch",
+        help="follow nodes' chains as an untrusted third party: verify "
+             "every beacon against the distributed key, report "
+             "forks/stalls/lag as they happen",
+    )
+    g.add_argument("--nodes", required=True,
+                   help="comma-separated REST base URLs of the nodes")
+    g.add_argument("--distkey",
+                   help="48-byte compressed collective G1 key (hex); "
+                        "default: trust-on-first-fetch from "
+                        "/api/info/distkey")
+    g.add_argument("--period", type=float,
+                   help="beacon period seconds (default: from "
+                        "/v1/status)")
+    g.add_argument("--genesis", type=int,
+                   help="genesis unix time (default: from /v1/status)")
+    g.add_argument("--interval", type=float, default=5.0,
+                   help="poll interval seconds (default 5)")
+    g.add_argument("--once", action="store_true",
+                   help="one observation pass; exit 1 if a fork or "
+                        "stall is currently detected")
+    g.add_argument("--json", action="store_true",
+                   help="print watch events as JSON lines")
+    g.set_defaults(fn=cmd_watch)
 
     g = sub.add_parser(
         "sim",
@@ -995,7 +1315,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write the replayable event log (JSON) here")
     s.add_argument("--json", action="store_true",
                    help="print the full report as JSON")
+    s.add_argument("--watch", action="store_true",
+                   help="attach an external ChainWatcher to the fabric; "
+                        "its verified verdict joins the report and its "
+                        "events the log")
     s.set_defaults(fn=cmd_sim_run)
+
+    s = sim_sub.add_parser(
+        "inspect",
+        help="render a sim event log as one merged cross-node timeline",
+    )
+    s.add_argument("events", help="event log JSON from `sim run --out`")
+    s.add_argument("--round", type=int,
+                   help="only events for this round")
+    s.set_defaults(fn=cmd_sim_inspect)
     return p
 
 

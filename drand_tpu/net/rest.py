@@ -90,6 +90,20 @@ def _verify_result_json(res) -> dict:
             "batch_size": res.batch_size}
 
 
+def _shed_body(error: str, exc) -> str:
+    """JSON body for an explicit gateway rejection: the reason, the
+    human detail, and — when the gateway stamped one — the request
+    span's trace id, so a shed client can pull its own trace from
+    `/debug/traces` instead of filing an anonymous 429."""
+    import json
+
+    body = {"error": error, "detail": str(exc)}
+    tid = getattr(exc, "trace_id", None)
+    if tid:
+        body["trace_id"] = tid
+    return json.dumps(body)
+
+
 async def handle_verify(gateway, request):
     """POST /v1/verify body: one claim {round, previous_round, previous,
     signature[, timeout]} -> {valid, cached, batch_size}; or
@@ -126,15 +140,20 @@ async def handle_verify(gateway, request):
         items = []
         for res in results:
             if isinstance(res, serve.Oversize):
-                items.append({"error": "oversize"})
+                err = {"error": "oversize"}
             elif isinstance(res, serve.Overloaded):
-                items.append({"error": "overloaded"})
+                err = {"error": "overloaded"}
             elif isinstance(res, serve.DeadlineExceeded):
-                items.append({"error": "deadline exceeded"})
+                err = {"error": "deadline exceeded"}
             elif isinstance(res, BaseException):
                 raise res
             else:
                 items.append(_verify_result_json(res))
+                continue
+            tid = getattr(res, "trace_id", None)
+            if tid:
+                err["trace_id"] = tid
+            items.append(err)
         return web.json_response({"items": items})
 
     req = _parse_verify_claim(body)
@@ -144,16 +163,26 @@ async def handle_verify(gateway, request):
                                    forwarded=forwarded)
     except serve.Oversize as exc:
         raise web.HTTPRequestEntityTooLarge(
-            max_size=exc.limit, actual_size=exc.actual, text=str(exc)
+            max_size=exc.limit, actual_size=exc.actual,
+            text=_shed_body("oversize", exc),
+            content_type="application/json",
         )
     except serve.Overloaded as exc:
         raise web.HTTPTooManyRequests(
-            text=str(exc), headers={"Retry-After": "1"}
+            text=_shed_body("overloaded", exc),
+            content_type="application/json",
+            headers={"Retry-After": "1"},
         )
     except serve.DeadlineExceeded as exc:
-        raise web.HTTPGatewayTimeout(text=str(exc))
+        raise web.HTTPGatewayTimeout(
+            text=_shed_body("deadline exceeded", exc),
+            content_type="application/json",
+        )
     except serve.GatewayClosed as exc:
-        raise web.HTTPServiceUnavailable(text=str(exc))
+        raise web.HTTPServiceUnavailable(
+            text=_shed_body("closed", exc),
+            content_type="application/json",
+        )
     return web.json_response(_verify_result_json(res))
 
 
@@ -226,8 +255,10 @@ def _add_obs_routes(routes: web.RouteTableDef, status_fn,
             limit = int(request.query.get("limit", "20"))
         except ValueError:
             raise web.HTTPBadRequest(text="limit must be an integer")
+        # deterministic contract: most-recently-updated trace first,
+        # at most `limit` of them (tests/test_obs_trace.py pins this)
         return web.json_response(
-            {"traces": trace.TRACER.recent(limit)}
+            {"traces": trace.TRACER.recent(max(0, limit))}
         )
 
     @routes.get("/debug/flight")
@@ -262,6 +293,36 @@ def build_verify_app(gateway) -> web.Application:
                             content_type="text/plain", charset="utf-8")
 
     _add_obs_routes(routes, gateway.stats)
+
+    app = web.Application()
+    app.add_routes(routes)
+    return app
+
+
+def build_fleet_app(aggregator) -> web.Application:
+    """Fleet observatory app (`cli fleet --serve`): one aggregated view
+    over N nodes' status/SLO documents plus this process's metrics
+    (which include the `drand_fleet_*` and `drand_watch_*` series)."""
+    routes = web.RouteTableDef()
+
+    @routes.get("/")
+    async def home(request):
+        return web.json_response({
+            "status": "fleet observatory",
+            "nodes": sorted(aggregator.sources),
+        })
+
+    @routes.get("/v1/fleet")
+    async def fleet_doc(request):
+        doc = await aggregator.poll()
+        return web.json_response(doc, dumps=_dumps_repr)
+
+    @routes.get("/metrics")
+    async def metrics_endpoint(request):
+        from drand_tpu.utils import metrics
+
+        return web.Response(text=metrics.render(),
+                            content_type="text/plain", charset="utf-8")
 
     app = web.Application()
     app.add_routes(routes)

@@ -163,6 +163,16 @@ def build_public_server(daemon, address: str,
             k == "x-drand-forwarded"
             for k, _ in (context.invocation_metadata() or ())
         )
+        def _shed_trailer(exc) -> None:
+            # a rejection carries the request span's id as trailing
+            # metadata so the shed client can correlate with
+            # /debug/traces (REST sheds carry the same id in the body)
+            tid = getattr(exc, "trace_id", None)
+            if tid:
+                context.set_trailing_metadata(
+                    (("x-drand-trace-id", tid),)
+                )
+
         try:
             res = await gw.verify(
                 req, request.timeout_seconds or None,
@@ -171,18 +181,22 @@ def build_public_server(daemon, address: str,
                 forwarded=forwarded,
             )
         except serve.Oversize as exc:
+            _shed_trailer(exc)
             await context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT, str(exc)
             )
         except serve.Overloaded as exc:
+            _shed_trailer(exc)
             await context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc)
             )
         except serve.DeadlineExceeded as exc:
+            _shed_trailer(exc)
             await context.abort(
                 grpc.StatusCode.DEADLINE_EXCEEDED, str(exc)
             )
         except serve.GatewayClosed as exc:
+            _shed_trailer(exc)
             await context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
         return pb.VerifyBeaconResponse(
             valid=res.valid, cached=res.cached, batch_size=res.batch_size

@@ -19,10 +19,19 @@ The triad any serving stack needs before it can be operated:
   missed/invalid partials, clock-skew estimates and suspect ranking.
 * `obs.profile` — single-flight on-demand device profiling behind
   `POST /debug/profile`.
+* `obs.watch`   — external chain watchdog: follow nodes as an untrusted
+  third party, verify every fetched beacon against the distributed key,
+  edge-trigger fork/stall/lag events (`drand_watch_*` metrics).
+* `obs.fleet`   — cross-node aggregation of status/SLO documents into
+  one fleet view (head spread, quorum margin, worst burn rate), served
+  at `GET /v1/fleet`.
 
 Import cost is trivially small (stdlib only), so protocol modules import
 this unconditionally; sampling off (`DRAND_TPU_TRACE=off` or
 `TRACER.set_enabled(False)`) reduces every span to a shared no-op.
+`obs.watch` and `obs.fleet` are deliberately NOT re-exported here: they
+import `beacon.chain` / `cli` respectively, and this package must stay
+feather-weight on the protocol import path.
 """
 
 from drand_tpu.obs.flight import RECORDER, FlightRecorder, install_crash_handler

@@ -1,0 +1,280 @@
+"""Fleet view: aggregate N nodes' observability documents into one.
+
+Every node already serves `/v1/status` (chain head, suspects, gateway
+pressure) and `/v1/slo` (error budgets, burn rates) — but a network-wide
+problem only shows up by diffing those documents ACROSS nodes: a fork is
+two nodes with irreconcilable heads, quorum risk is "how many nodes can
+we lose before threshold", and a suspect is only credible when several
+peers independently rank it.  `aggregate()` is that diff, pure over
+captured documents (tests, the CLI and the REST endpoint all share it);
+`FleetAggregator` does the polling and exports `drand_fleet_*` gauges;
+`GET /v1/fleet` (net/rest.py) and `cli fleet` serve the result.
+
+An optional `ChainWatcher` snapshot folds the *verified* third-party
+view in: self-reported heads that run ahead of what actually verifies
+against the distributed key become `disputes` — a Byzantine node can lie
+in its own status document, but not to the pairing check.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Awaitable, Callable, Dict, Optional
+
+from drand_tpu.utils import metrics
+
+#: a source returns {"status": dict, "slo": dict} for one node; raising
+#: marks the node unreachable in the fleet view
+Source = Callable[[], Awaitable[dict]]
+
+_spread_gauge = metrics.gauge(
+    "drand_fleet_head_spread",
+    "max - min chain head across reachable fleet nodes")
+_margin_gauge = metrics.gauge(
+    "drand_fleet_quorum_margin",
+    "healthy nodes minus group threshold (negative = below quorum)")
+_burn_gauge = metrics.gauge(
+    "drand_fleet_worst_burn_rate",
+    "worst SLO long-window burn rate across the fleet")
+_reach_gauge = metrics.gauge(
+    "drand_fleet_nodes_reachable", "nodes that answered the last poll")
+
+
+def _worst_burn(slo_doc: Optional[dict]) -> Optional[dict]:
+    """Largest long-window burn rate in one node's SLO document."""
+    worst = None
+    for name, obj in sorted(((slo_doc or {}).get("objectives")
+                             or {}).items()):
+        for window, rate in sorted((obj.get("burn_rates") or {}).items()):
+            try:
+                rate = float(rate)
+            except (TypeError, ValueError):
+                continue
+            if worst is None or rate > worst["rate"]:
+                worst = {"objective": name, "window": window, "rate": rate}
+    return worst
+
+
+def _min_budget(slo_doc: Optional[dict]) -> Optional[dict]:
+    worst = None
+    for name, obj in sorted(((slo_doc or {}).get("objectives")
+                             or {}).items()):
+        rem = obj.get("budget_remaining")
+        if rem is None:
+            continue
+        if worst is None or rem < worst["remaining"]:
+            worst = {"objective": name, "remaining": rem}
+    return worst
+
+
+def aggregate(node_docs: Dict[str, dict], watch: Optional[dict] = None,
+              now: Optional[float] = None) -> dict:
+    """Fold per-node documents into the fleet view.
+
+    `node_docs` maps node name -> {"status": dict|None, "slo":
+    dict|None[, "error": str]}; an "error" entry marks the node
+    unreachable (its stale documents, if any, are ignored).  `watch` is
+    an optional `ChainWatcher.snapshot()` supplying the independently
+    VERIFIED heads.
+    """
+    from drand_tpu.cli import diagnose  # lazy: cli imports are heavy-ish
+
+    now = time.time() if now is None else now
+    nodes = {}
+    heads, healthy, threshold = {}, [], None
+    worst_burn, min_budget = None, None
+    suspect_votes: Dict[str, list] = {}
+
+    for name in sorted(node_docs):
+        doc = node_docs[name] or {}
+        err = doc.get("error")
+        status = doc.get("status") if not err else None
+        slo_doc = doc.get("slo") if not err else None
+        chain = (status or {}).get("chain") or {}
+        head = chain.get("head_round")
+        expected = chain.get("expected_round")
+        running = bool(chain.get("running"))
+        if head is not None:
+            heads[name] = head
+        if threshold is None:
+            threshold = chain.get("threshold")
+
+        burn = _worst_burn(slo_doc)
+        budget = _min_budget(slo_doc)
+        if burn and (worst_burn is None or burn["rate"] > worst_burn["rate"]):
+            worst_burn = dict(burn, node=name)
+        if budget and (min_budget is None
+                       or budget["remaining"] < min_budget["remaining"]):
+            min_budget = dict(budget, node=name)
+
+        for s in (status or {}).get("suspects") or []:
+            peer = s.get("peer")
+            if peer:
+                suspect_votes.setdefault(peer, []).append(
+                    (name, s.get("score")))
+
+        findings = diagnose(status, slo_doc, []) if status else []
+        nodes[name] = {
+            "reachable": not err,
+            **({"error": err} if err else {}),
+            "head": head,
+            "expected": expected,
+            "running": running,
+            "lag": (expected - head
+                    if head is not None and expected is not None else None),
+            "worst_burn": burn,
+            "min_budget": budget,
+            "findings": [f for f in findings if f["kind"] != "healthy"],
+        }
+
+    top = max(heads.values(), default=None)
+    low = min(heads.values(), default=None)
+    for name, head in heads.items():
+        # healthy = reachable, loop running, head within one round of
+        # the fleet max: the set the threshold can still count on
+        if nodes[name]["running"] and head >= (top or 0) - 1:
+            healthy.append(name)
+
+    # a suspect only makes the fleet view when >1 node independently
+    # ranks it (one accuser could itself be the problem)
+    consensus = []
+    for peer in sorted(suspect_votes):
+        votes = suspect_votes[peer]
+        scores = [s for _, s in votes if isinstance(s, (int, float))]
+        consensus.append({
+            "peer": peer,
+            "reported_by": sorted(n for n, _ in votes),
+            "score": (round(sum(scores) / len(scores), 3)
+                      if scores else None),
+        })
+    consensus.sort(key=lambda c: (-len(c["reported_by"]), c["peer"]))
+
+    doc = {
+        "time": now,
+        "nodes": nodes,
+        "reachable": sum(1 for n in nodes.values() if n["reachable"]),
+        "head": {"max": top, "min": low,
+                 "spread": (top - low
+                            if top is not None and low is not None
+                            else None)},
+        "quorum": {
+            "threshold": threshold,
+            "healthy": sorted(healthy),
+            "margin": (len(healthy) - threshold
+                       if threshold is not None else None),
+        },
+        "slo": {"worst_burn_rate": worst_burn,
+                "min_budget_remaining": min_budget},
+        "suspects": consensus,
+    }
+
+    if watch is not None:
+        verified = {p: v.get("head", 0)
+                    for p, v in (watch.get("peers") or {}).items()}
+        disputes = []
+        for name, claimed in sorted(heads.items()):
+            v = verified.get(name)
+            # one round of slack: the node may have finalized since the
+            # watcher's last poll — beyond that the claim is unbacked
+            if v is not None and claimed > v + 1:
+                disputes.append({"node": name, "claimed_head": claimed,
+                                 "verified_head": v})
+        doc["watch"] = {
+            "max_verified_head": watch.get("max_head"),
+            "stalled": watch.get("stalled"),
+            "forks": watch.get("forks"),
+            "verified_heads": verified,
+            "disputes": disputes,
+        }
+    return doc
+
+
+class FleetAggregator:
+    """Polls every source and folds the answers through `aggregate`.
+
+    `sources` maps node name -> async callable returning {"status": ...,
+    "slo": ...}; `watch` is an optional `ChainWatcher` whose verified
+    snapshot joins each poll.
+    """
+
+    def __init__(self, sources: Dict[str, Source], watch=None,
+                 now_fn=time.time):
+        self.sources = dict(sources)
+        self.watch = watch
+        self.now_fn = now_fn
+        self.last: Optional[dict] = None
+
+    async def poll(self) -> dict:
+        docs: Dict[str, dict] = {}
+        for name in sorted(self.sources):
+            try:
+                docs[name] = await self.sources[name]()
+            except Exception as exc:
+                docs[name] = {"error": str(exc)[:160]}
+        watch_snap = self.watch.snapshot() if self.watch is not None else None
+        doc = aggregate(docs, watch=watch_snap, now=self.now_fn())
+        spread = doc["head"]["spread"]
+        if spread is not None:
+            _spread_gauge.set(spread)
+        margin = doc["quorum"]["margin"]
+        if margin is not None:
+            _margin_gauge.set(margin)
+        burn = doc["slo"]["worst_burn_rate"]
+        if burn is not None:
+            _burn_gauge.set(burn["rate"])
+        _reach_gauge.set(doc["reachable"])
+        self.last = doc
+        return doc
+
+
+def render_fleet(doc: dict) -> str:
+    """One fleet document as a TTY table (cli fleet / cli watch)."""
+    lines = []
+    head = doc.get("head") or {}
+    quorum = doc.get("quorum") or {}
+    lines.append(
+        f"fleet: {doc.get('reachable')}/{len(doc.get('nodes') or {})} "
+        f"reachable   head max={head.get('max')} "
+        f"spread={head.get('spread')}   "
+        f"quorum margin={quorum.get('margin')} "
+        f"(threshold={quorum.get('threshold')})")
+    burn = (doc.get("slo") or {}).get("worst_burn_rate")
+    if burn:
+        lines.append(
+            f"worst burn: {burn['rate']}x ({burn.get('node')} "
+            f"{burn.get('objective')}/{burn.get('window')})")
+    lines.append(f"{'node':20s} {'head':>6s} {'lag':>4s} "
+                 f"{'run':>3s} {'findings'}")
+    for name in sorted(doc.get("nodes") or {}):
+        n = doc["nodes"][name]
+        if not n.get("reachable"):
+            lines.append(f"{name:20s} {'-':>6s} {'-':>4s} {'-':>3s} "
+                         f"UNREACHABLE: {n.get('error', '')}")
+            continue
+        finds = ", ".join(
+            f"{f['severity']}:{f['kind']}" for f in n.get("findings") or []
+        ) or "-"
+        lines.append(
+            f"{name:20s} {str(n.get('head')):>6s} "
+            f"{str(n.get('lag')):>4s} "
+            f"{'y' if n.get('running') else 'N':>3s} {finds}")
+    watch = doc.get("watch")
+    if watch:
+        lines.append(
+            f"watch: verified head={watch.get('max_verified_head')} "
+            f"stalled={watch.get('stalled')} "
+            f"forks={len(watch.get('forks') or [])}")
+        for d in watch.get("disputes") or []:
+            lines.append(
+                f"  DISPUTE {d['node']}: claims round "
+                f"{d['claimed_head']} but only {d['verified_head']} "
+                f"verified")
+        for f in watch.get("forks") or []:
+            lines.append(
+                f"  FORK at round {f.get('divergence_round')} "
+                f"({f.get('peer')}): {f.get('detail')}")
+    for s in doc.get("suspects") or []:
+        lines.append(
+            f"suspect {s['peer']} reported by "
+            f"{len(s['reported_by'])} node(s), mean score {s['score']}")
+    return "\n".join(lines)

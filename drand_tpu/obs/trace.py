@@ -244,6 +244,15 @@ class Tracer:
     def add_sink(self, fn: Callable[[dict], None]) -> None:
         self._sinks.append(fn)
 
+    def remove_sink(self, fn: Callable[[dict], None]) -> None:
+        """Detach a sink added with `add_sink`; unknown sinks are a
+        no-op (scoped consumers like the sim's span lens detach on
+        teardown without caring whether setup got that far)."""
+        try:
+            self._sinks.remove(fn)
+        except ValueError:
+            pass
+
     def get_trace(self, trace_id: str) -> Optional[dict]:
         with self._lock:
             spans = self._traces.get(trace_id)
@@ -252,7 +261,13 @@ class Tracer:
             return {"trace_id": trace_id, "spans": [dict(s) for s in spans]}
 
     def recent(self, n: int = 20) -> List[dict]:
-        """The n most recently updated traces, newest first."""
+        """The n most recently updated traces, newest first.
+
+        Ordering is part of the `/debug/traces` contract: a trace moves
+        to the front every time one of its spans finishes, so index 0 is
+        always the trace that last saw activity."""
+        if n <= 0:
+            return []
         with self._lock:
             ids = list(self._traces.keys())[-n:][::-1]
             return [

@@ -1,0 +1,393 @@
+"""External chain watchdog: follow beacon nodes as an untrusted third
+party.
+
+The paper's core promise is that anyone holding the distributed public
+key can verify the chain — this module is that promise turned into an
+operational tool.  A `ChainWatcher` polls one or more nodes' chains
+through pluggable fetchers (the sim fabric, a node's public REST API, a
+test stub), verifies everything it fetches through the SAME
+batched/sharded pairing path the nodes use (`scheme.verify_chain_batch`
+against the distributed key), and maintains a per-peer map of *verified*
+heads.  Nothing a peer merely claims enters the watcher's world view:
+a forged beacon fails the pairing check and is dropped at the door, so
+a Byzantine node can at worst under-report its own progress.
+
+On top of the verified view the watcher edge-triggers typed events —
+each fires once per state change, into the local event list and an
+injectable flight recorder:
+
+* ``watch_fork``         — two verified branches disagree; carries the
+  divergence round (the first round where the histories conflict:
+  either two different beacons for one round, or one chain *bridging
+  over* a round another chain finalized).  This is the detection half
+  of ROADMAP direction 1; the resolution policy lands separately.
+* ``watch_stalled`` / ``watch_resumed`` — no verified head progress for
+  `stall_periods` beacon periods while the schedule marched >= 2
+  rounds ahead.
+* ``watch_head_lag`` / ``watch_catchup`` — a peer fell `lag_rounds`
+  behind the fleet's verified head / progressed while lagging (with
+  from/to rounds) or caught back up.
+* ``watch_bad_beacon`` / ``watch_bad_chain`` — a fetched beacon failed
+  the pairing check / a peer's own chain did not link.
+* ``watch_peer_unreachable`` / ``watch_peer_ok`` — fetch transport
+  failed / recovered.
+
+Prometheus series (``drand_watch_*``) mirror the events so the alert
+rules in deploy/prometheus-alerts.yml can page on a fork or stall that
+NO in-node exporter would ever admit to.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from drand_tpu.beacon.chain import Beacon, beacon_message, current_round
+from drand_tpu.utils import metrics
+
+#: a fetcher returns the peer's chain from `from_round` (inclusive),
+#: oldest first; raising means the peer is unreachable this poll
+Fetcher = Callable[[int], Awaitable[List[Beacon]]]
+
+_polls = metrics.counter(
+    "drand_watch_polls_total", "observation passes the watcher ran")
+_verified = metrics.counter(
+    "drand_watch_verified_rounds_total",
+    "beacons that passed the pairing check against the distributed key")
+_bad_beacons = metrics.counter(
+    "drand_watch_bad_beacons_total",
+    "fetched beacons that FAILED the pairing check (forgeries)")
+_forks_total = metrics.counter(
+    "drand_watch_forks_total", "distinct chain divergences detected")
+_fork_gauge = metrics.gauge(
+    "drand_watch_fork_detected",
+    "number of distinct verified-chain divergences currently known "
+    "(alert on > 0)")
+_stalled_gauge = metrics.gauge(
+    "drand_watch_stalled",
+    "1 while the verified chain head is stalled behind the schedule")
+_head_gauge = metrics.gauge(
+    "drand_watch_head_round", "maximum verified head across watched peers")
+
+
+class ChainWatcher:
+    """Read-only third-party chain follower over untrusted peers.
+
+    `dist_key`/`scheme` do the trust: every fetched beacon must carry a
+    valid group threshold signature over its chained message before the
+    watcher believes anything about it.  `clock` is injectable (an
+    object with ``now()``) so the simulator can drive stall detection on
+    simulated time; `recorder` (a `FlightRecorder`) receives every typed
+    event alongside the local ``events`` list.
+    """
+
+    def __init__(self, dist_key, scheme, period: float, genesis_time: int,
+                 sources: Optional[Dict[str, Fetcher]] = None, *,
+                 clock=None, recorder=None, stall_periods: int = 3,
+                 lag_rounds: int = 2, fetch_limit: int = 256,
+                 max_events: int = 4096):
+        self.dist_key = dist_key
+        self.scheme = scheme
+        self.period = float(period)
+        self.genesis_time = genesis_time
+        self.clock = clock
+        self.recorder = recorder
+        self.stall_periods = stall_periods
+        self.lag_rounds = lag_rounds
+        self.fetch_limit = fetch_limit
+        self.max_events = max_events
+
+        self.sources: Dict[str, Fetcher] = {}
+        #: per-peer verified state: head round, chain tail beacon,
+        #: transport status, lagging edge
+        self.peers: Dict[str, dict] = {}
+        #: the canonical verified chain: first fully-verified beacon
+        #: seen for each round wins (detection only — no reorg policy)
+        self.chain: Dict[int, Beacon] = {}
+        #: round -> bridging beacon's round, for every round some
+        #: adopted beacon's link asserts was skipped
+        self._skipped: Dict[int, int] = {}
+        self.forks: List[dict] = []
+        self._fork_keys: set = set()
+        self.stalled = False
+        self.max_head = 0
+        self._last_progress_at: Optional[float] = None
+        self.events: List[dict] = []
+
+        for addr, fetch in sorted((sources or {}).items()):
+            self.add_source(addr, fetch)
+
+    # -- wiring ------------------------------------------------------------
+
+    def add_source(self, addr: str, fetch: Fetcher) -> None:
+        self.sources[addr] = fetch
+        self.peers.setdefault(addr, {
+            "head": 0, "tail": None, "status": "unknown",
+            "lagging": False, "bad": 0,
+        })
+
+    def _now(self) -> float:
+        return self.clock.now() if self.clock is not None else time.time()
+
+    def _event(self, kind: str, **fields) -> dict:
+        ev = {"kind": kind, "ts": self._now()}
+        ev.update(fields)
+        self.events.append(ev)
+        if len(self.events) > self.max_events:
+            del self.events[: len(self.events) - self.max_events]
+        if self.recorder is not None:
+            self.recorder.record(kind, **fields)
+        return ev
+
+    # -- observation pass --------------------------------------------------
+
+    async def poll(self) -> dict:
+        """One observation pass over every source (sorted, so replays
+        are deterministic); returns `snapshot()`."""
+        _polls.inc()
+        for addr in sorted(self.sources):
+            await self._poll_peer(addr)
+        self._update_lag()
+        self._update_stall()
+        self._update_metrics()
+        return self.snapshot()
+
+    async def _poll_peer(self, addr: str) -> None:
+        st = self.peers[addr]
+        try:
+            batch = await self.sources[addr](st["head"] + 1)
+        except Exception as exc:
+            if st["status"] != "unreachable":
+                self._event("watch_peer_unreachable", peer=addr,
+                            error=str(exc)[:160])
+            st["status"] = "unreachable"
+            return
+        if st["status"] == "unreachable":
+            self._event("watch_peer_ok", peer=addr)
+        st["status"] = "ok"
+        batch = [b for b in batch if b.round > st["head"]]
+        batch = batch[: self.fetch_limit]
+        if not batch:
+            return
+
+        # the peer's own chain must link before we spend pairings on it;
+        # a beacon that instead links some OTHER verified round (e.g. a
+        # round-7 with prev_round=5 while round 6 is finalized) is a
+        # fork branch, not garbage — anchor it against the canonical
+        # chain and let `_observe` name the divergence round
+        linked: List[Beacon] = []
+        prev = st["tail"]
+        for b in batch:
+            if prev is not None and (b.prev_round != prev.round
+                                     or b.prev_sig != prev.signature):
+                anchor = self.chain.get(b.prev_round)
+                if anchor is None or anchor.signature != b.prev_sig:
+                    st["bad"] += 1
+                    self._event(
+                        "watch_bad_chain", peer=addr, round=b.round,
+                        detail=f"links prev_round={b.prev_round} after "
+                               f"verified head {prev.round}")
+                    break
+            linked.append(b)
+            prev = b
+        if not linked:
+            return
+
+        # the trust boundary: one batched pairing check over the whole
+        # fetched segment (sharded across devices when the scheme can)
+        msgs = [beacon_message(b.prev_sig, b.prev_round, b.round)
+                for b in linked]
+        sigs = [b.signature for b in linked]
+        ok = self.scheme.verify_chain_batch(self.dist_key, msgs, sigs)
+        good: List[Beacon] = []
+        for b, valid in zip(linked, ok):
+            if not valid:
+                st["bad"] += 1
+                _bad_beacons.inc()
+                self._event("watch_bad_beacon", peer=addr, round=b.round)
+                break  # everything after chains onto a forgery
+            good.append(b)
+        if not good:
+            return
+
+        old_head = st["head"]
+        st["tail"] = good[-1]
+        st["head"] = good[-1].round
+        _verified.inc(len(good))
+        for b in good:
+            self._observe(addr, b)
+        if st["lagging"] and st["head"] > old_head:
+            self._event("watch_catchup", peer=addr,
+                        from_round=old_head, to_round=st["head"])
+
+    # -- fork detection ----------------------------------------------------
+
+    def _observe(self, addr: str, b: Beacon) -> None:
+        """Fold one VERIFIED beacon into the canonical chain, flagging
+        any disagreement as a fork with its divergence round."""
+        have = self.chain.get(b.round)
+        if have is not None:
+            if (have.signature, have.prev_round, have.prev_sig) != \
+                    (b.signature, b.prev_round, b.prev_sig):
+                self._fork(addr, b.round,
+                           f"{addr} holds a different beacon for round "
+                           f"{b.round} than the canonical chain")
+            return
+        # the incoming link bridges over rounds the canonical chain has
+        for r in range(b.prev_round + 1, b.round):
+            if r in self.chain:
+                self._fork(addr, r,
+                           f"{addr}'s chain bridges over round {r} "
+                           f"({b.prev_round}->{b.round}) but the "
+                           f"canonical chain finalized it")
+                return  # forked branch: do not adopt
+        # a previously-adopted link bridged over THIS round
+        bridger = self._skipped.get(b.round)
+        if bridger is not None:
+            self._fork(addr, b.round,
+                       f"{addr} finalized round {b.round}, which the "
+                       f"canonical chain bridged over "
+                       f"(link into round {bridger})")
+            return
+        prev = self.chain.get(b.prev_round)
+        if prev is not None and prev.signature != b.prev_sig:
+            self._fork(addr, b.round,
+                       f"{addr}'s round {b.round} links a different "
+                       f"round-{b.prev_round} signature than the "
+                       f"canonical chain")
+            return
+        self.chain[b.round] = b
+        for r in range(b.prev_round + 1, b.round):
+            self._skipped[r] = b.round
+
+    def _fork(self, peer: str, divergence_round: int, detail: str) -> None:
+        key = (peer, divergence_round)
+        if key in self._fork_keys:
+            return  # edge-triggered: one event per distinct divergence
+        self._fork_keys.add(key)
+        info = {"peer": peer, "divergence_round": divergence_round,
+                "detail": detail}
+        self.forks.append(info)
+        _forks_total.inc()
+        self._event("watch_fork", peer=peer,
+                    divergence_round=divergence_round, detail=detail)
+
+    # -- stall / lag -------------------------------------------------------
+
+    def expected_round(self, now: Optional[float] = None) -> int:
+        return current_round(self._now() if now is None else now,
+                             self.period, self.genesis_time)
+
+    def _update_lag(self) -> None:
+        heads = [st["head"] for st in self.peers.values()]
+        top = max(heads, default=0)
+        for addr in sorted(self.peers):
+            st = self.peers[addr]
+            behind = top - st["head"]
+            if behind >= self.lag_rounds and not st["lagging"]:
+                st["lagging"] = True
+                self._event("watch_head_lag", peer=addr,
+                            head=st["head"], behind=behind)
+            elif behind < self.lag_rounds and st["lagging"]:
+                st["lagging"] = False
+                self._event("watch_caught_up", peer=addr, head=st["head"])
+
+    def _update_stall(self) -> None:
+        now = self._now()
+        top = max((st["head"] for st in self.peers.values()), default=0)
+        if self._last_progress_at is None or top > self.max_head:
+            self.max_head = max(self.max_head, top)
+            self._last_progress_at = now
+        expected = self.expected_round(now)
+        idle = now - self._last_progress_at
+        stalled = (expected - self.max_head >= 2
+                   and idle >= self.stall_periods * self.period)
+        if stalled and not self.stalled:
+            self._event("watch_stalled", head=self.max_head,
+                        expected=expected,
+                        behind=expected - self.max_head,
+                        idle_seconds=idle)
+        elif self.stalled and not stalled:
+            self._event("watch_resumed", head=self.max_head,
+                        expected=expected)
+        self.stalled = stalled
+
+    def _update_metrics(self) -> None:
+        _fork_gauge.set(len(self._fork_keys))
+        _stalled_gauge.set(1.0 if self.stalled else 0.0)
+        _head_gauge.set(self.max_head)
+        for addr in sorted(self.peers):
+            st = self.peers[addr]
+            metrics.gauge(
+                "drand_watch_peer_head_round",
+                "per-peer verified chain head",
+                labels={"peer": addr}).set(st["head"])
+            metrics.gauge(
+                "drand_watch_peer_head_lag",
+                "rounds the peer's verified head trails the fleet max",
+                labels={"peer": addr}).set(
+                    max(0, self.max_head - st["head"]))
+
+    # -- views -------------------------------------------------------------
+
+    def heads(self) -> Dict[str, int]:
+        """Per-peer VERIFIED head rounds (claims never enter this map)."""
+        return {addr: st["head"] for addr, st in sorted(self.peers.items())}
+
+    def snapshot(self) -> dict:
+        now = self._now()
+        return {
+            "time": now,
+            "period": self.period,
+            "genesis_time": self.genesis_time,
+            "expected_round": self.expected_round(now),
+            "max_head": self.max_head,
+            "stalled": self.stalled,
+            "forks": [dict(f) for f in self.forks],
+            "peers": {
+                addr: {
+                    "head": st["head"],
+                    "lag": max(0, self.max_head - st["head"]),
+                    "status": st["status"],
+                    "lagging": st["lagging"],
+                    "bad": st["bad"],
+                }
+                for addr, st in sorted(self.peers.items())
+            },
+            "events_total": len(self.events),
+        }
+
+
+def rest_source(base_url: str, timeout: float = 5.0) -> Fetcher:
+    """Chain fetcher over a node's public REST API (`/api/public[...]`).
+
+    Blocking urllib under the hood — meant for the CLI watch loop, not
+    for serving threads.  The node is untrusted: whatever it returns
+    still has to pass the watcher's pairing check.
+    """
+    base = base_url.rstrip("/")
+
+    def _get(path: str) -> dict:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    def _beacon(j: dict) -> Beacon:
+        return Beacon(
+            round=int(j["round"]),
+            prev_round=int(j["previous_round"]),
+            prev_sig=bytes.fromhex(j["previous"]),
+            signature=bytes.fromhex(j["signature"]),
+        )
+
+    async def fetch(from_round: int) -> List[Beacon]:
+        head = _beacon(_get("/api/public"))
+        if head.round < from_round:
+            return []
+        out = [_beacon(_get(f"/api/public/{r}"))
+               for r in range(from_round, head.round)]
+        out.append(head)
+        return out
+
+    return fetch

@@ -141,7 +141,14 @@ def _consume_exception(fut: "asyncio.Future") -> None:
 
 
 class GatewayError(Exception):
-    """Base class for explicit gateway rejections."""
+    """Base class for explicit gateway rejections.
+
+    `trace_id` is stamped by `verify()` with the request span's id
+    before the exception leaves the gateway, so a shed/timeout response
+    can point its caller at `/debug/traces` — a rejection should never
+    be anonymous."""
+
+    trace_id: Optional[str] = None
 
 
 class Overloaded(GatewayError):
@@ -374,9 +381,11 @@ class VerifyGateway:
             try:
                 res = await self._verify_inner(req, timeout, span, client,
                                                forwarded=forwarded)
-            except GatewayError:
+            except GatewayError as exc:
                 # a request we refused or lost IS an SLO event: the
-                # caller asked and was not answered
+                # caller asked and was not answered — but not anonymous:
+                # the response carries the span id for /debug/traces
+                exc.trace_id = span.trace_id
                 obs_slo.ENGINE.record_bad(obs_slo.VERIFY_LATENCY)
                 raise
             obs_slo.ENGINE.observe(obs_slo.VERIFY_LATENCY,

@@ -33,9 +33,11 @@ from drand_tpu.beacon.handler import BeaconConfig, BeaconHandler
 from drand_tpu.beacon.store import BeaconStore
 from drand_tpu.crypto import refimpl as ref
 from drand_tpu.crypto import tbls
-from drand_tpu.crypto.poly import PriPoly
 from drand_tpu.key import Group, Pair, Share
+from drand_tpu.crypto.poly import PriPoly
+from drand_tpu.obs import trace as obs_trace
 from drand_tpu.obs.flight import FlightRecorder
+from drand_tpu.obs.watch import ChainWatcher
 from drand_tpu.sim.fabric import (
     BYZANTINE_STRATEGIES,
     FabricClient,
@@ -135,6 +137,64 @@ class SimNode:
         await self.handler.catchup()
 
 
+class SimWatcher:
+    """A third-party `ChainWatcher` riding the sim fabric.
+
+    Registered like a node (so partitions and deafness apply to it — an
+    observer loses sight of a node the network can't reach), but it
+    holds no share, serves no handler, and never sends: it only drains
+    `sync_stream` from each peer and feeds the verified beacons to the
+    wrapped `ChainWatcher`.  Its own links are pinned to zero
+    latency/loss before every poll so an observation pass completes at
+    one sim instant regardless of what the scenario did to the mesh —
+    the runner awaits `poll()` directly and nothing else would advance
+    the clock the watcher would otherwise sleep on."""
+
+    address = "watch00"
+
+    def __init__(self, world: "SimWorld", stall_periods: int = 3):
+        self.world = world
+        self.up = True
+        self.handler = None  # never serves; fabric treats us as silent
+        sources = {
+            node.address: self._fetcher(node.address)
+            for node in world.nodes
+        }
+        self.chain_watcher = ChainWatcher(
+            world.dist_key, world.scheme,
+            period=world.group.period,
+            genesis_time=world.group.genesis_time,
+            sources=sources,
+            clock=SkewedClock(world.clock, 0.0),
+            recorder=world.recorder,
+            stall_periods=stall_periods,
+        )
+
+    def _fetcher(self, addr: str):
+        async def fetch(from_round: int):
+            out = []
+            async for b in self.world.fabric.sync_stream(
+                    self.address, addr, from_round):
+                out.append(b)
+            return out
+        return fetch
+
+    def _pin_links(self) -> None:
+        for node in self.world.nodes:
+            for src, dst in ((self.address, node.address),
+                             (node.address, self.address)):
+                self.world.fabric.link(src, dst).configure(
+                    latency=0.0, jitter=0.0, drop=0.0, dup=0.0,
+                    reorder=0.0)
+
+    async def poll(self) -> dict:
+        self._pin_links()
+        return await self.chain_watcher.poll()
+
+    def snapshot(self) -> dict:
+        return self.chain_watcher.snapshot()
+
+
 class SimWorld:
     """The whole simulated network plus its ground truth (the secret
     polynomial) and the scenario event log."""
@@ -192,6 +252,45 @@ class SimWorld:
         #: needs the clock to keep advancing, so it must not block the
         #: runner that advances it)
         self._bg: set = set()
+        #: attached third-party observer (attach_watcher); None by
+        #: default so plain runs stay byte-identical to earlier seeds
+        self.watcher: Optional[SimWatcher] = None
+        self._span_lens = None
+
+    # -- observatory -------------------------------------------------------
+
+    def attach_watcher(self, stall_periods: int = 3) -> SimWatcher:
+        """Attach an external `ChainWatcher` to the fabric and start
+        merging per-node tracer spans into the event log.
+
+        The watcher is a fabric citizen (deafness/partitions apply),
+        its typed `watch_*` events land in `self.recorder` next to the
+        nodes' own events, and the span lens adds one `node_span` event
+        per finished beacon-stage span — together they make the event
+        log a single cross-node timeline (`cli sim inspect`)."""
+        if self.watcher is not None:
+            return self.watcher
+        self.watcher = SimWatcher(self, stall_periods=stall_periods)
+        self.fabric.register(self.watcher)
+
+        def _lens(d: dict) -> None:
+            attrs = d.get("attrs") or {}
+            node = attrs.get("node")
+            if node is None or not d.get("name", "").startswith("beacon."):
+                return
+            fields = {"name": d["name"], "node": node,
+                      "status": d.get("status", "ok")}
+            for key in ("round", "peer", "from_round", "to_round"):
+                if key in attrs:
+                    fields[key] = attrs[key]
+            # deliberately NO trace ids or durations: sync spans carry
+            # random trace ids and durations are wall-clock — either
+            # would break byte-identical replay
+            self.recorder.record("node_span", **fields)
+
+        self._span_lens = _lens
+        obs_trace.TRACER.add_sink(_lens)
+        return self.watcher
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -204,6 +303,9 @@ class SimWorld:
                              seed=self.seed)
 
     async def stop_all(self) -> None:
+        if self._span_lens is not None:
+            obs_trace.TRACER.remove_sink(self._span_lens)
+            self._span_lens = None
         for task in list(self._bg):
             if not task.done():
                 task.cancel()

@@ -118,11 +118,16 @@ class SimReport:
     heads: Dict[str, int]
     doctor: Dict[str, list]
     event_log: str
+    #: attached observer's verdict (`ChainWatcher.snapshot()`) when the
+    #: run was made with watch=True; None otherwise
+    watch: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = asdict(self)
         # the event log is a document of its own, not a summary field
         d.pop("event_log")
+        if d.get("watch") is None:
+            d.pop("watch", None)
         return d
 
     def to_json(self, indent: Optional[int] = 2) -> str:
@@ -147,24 +152,29 @@ def _node_status(node, genesis: int, period: float) -> dict:
     }
 
 
-async def _run(scn: Scenario, seed: int) -> SimReport:
+async def _run(scn: Scenario, seed: int, watch: bool = False) -> SimReport:
     world = SimWorld(
         n=scn.n, threshold=scn.threshold, period=scn.period, seed=seed,
         skews=scn.skews, byzantine=scn.byzantine,
         sync_batch=scn.sync_batch, default_link=scn.default_link,
     )
     inv = InvariantState(scheme=world.scheme, dist_key=world.dist_key)
+    if watch:
+        world.attach_watcher()
     await world.start_all()
     genesis = world.group.genesis_time
     period = world.group.period
 
     # the timeline: fault events + one checkpoint per round, in time
-    # order; at equal times fault events apply before the checkpoint
+    # order; at equal times fault events apply before the checkpoint.
+    # With a watcher attached, two extra checkpoints past the last round
+    # give its stall detector the missed-period window it needs.
+    checkpoints = scn.rounds + (2 if watch else 0)
     stops = [(genesis + ev.at, 0, i, ("event", ev))
              for i, ev in enumerate(scn.events)]
     stops += [(genesis + (k - 1) * period + scn.settle_margin, 1, k,
                ("checkpoint", k))
-              for k in range(1, scn.rounds + 1)]
+              for k in range(1, checkpoints + 1)]
     stops.sort(key=lambda s: (s[0], s[1], s[2]))
 
     for when, _, _, (kind, payload) in stops:
@@ -173,13 +183,17 @@ async def _run(scn: Scenario, seed: int) -> SimReport:
             await world.apply(payload.action, payload.args)
             await world.settle()
         else:
-            fresh = inv.checkpoint(world, expected_round=payload)
-            heads = sorted(
-                (n.address, n.store.last().round if n.store.last() else 0)
-                for n in world.nodes if n.address in world.honest)
-            world.recorder.record(
-                "invariant_check", round=payload,
-                new_violations=len(fresh), heads=dict(heads))
+            if payload <= scn.rounds:
+                fresh = inv.checkpoint(world, expected_round=payload)
+                heads = sorted(
+                    (n.address,
+                     n.store.last().round if n.store.last() else 0)
+                    for n in world.nodes if n.address in world.honest)
+                world.recorder.record(
+                    "invariant_check", round=payload,
+                    new_violations=len(fresh), heads=dict(heads))
+            if world.watcher is not None:
+                await world.watcher.poll()
 
     stalled = inv.stalled()
 
@@ -245,6 +259,8 @@ async def _run(scn: Scenario, seed: int) -> SimReport:
         heads={a: heads[a] for a in sorted(heads)},
         failures=list(failures),
     )
+    watch_snap = (world.watcher.snapshot()
+                  if world.watcher is not None else None)
     await world.stop_all()
 
     return SimReport(
@@ -253,16 +269,22 @@ async def _run(scn: Scenario, seed: int) -> SimReport:
         violations=[v.to_dict() for v in inv.violations],
         stalled=stalled, heads=heads, doctor=doctor,
         event_log=world.recorder.dump(),
+        watch=watch_snap,
     )
 
 
 def run_scenario(scenario, seed: int = 1,
                  nodes: Optional[int] = None,
-                 rounds: Optional[int] = None) -> SimReport:
+                 rounds: Optional[int] = None,
+                 watch: bool = False) -> SimReport:
     """Run a scenario (by name or `Scenario` object) to completion.
 
     Same (scenario, seed) -> byte-identical `SimReport.event_log`,
-    across processes and PYTHONHASHSEED values.
+    across processes and PYTHONHASHSEED values.  `watch=True` attaches
+    an external `ChainWatcher` to the fabric: its verified verdict
+    lands in `SimReport.watch` and its typed events (plus per-node
+    tracer spans) join the event log — a different, richer log than the
+    plain run's, equally deterministic per (scenario, seed, watch).
     """
     import asyncio
 
@@ -275,5 +297,9 @@ def run_scenario(scenario, seed: int = 1,
     # SimReport shape; the registry and CLI treat them uniformly
     runner = getattr(scenario, "run", None)
     if runner is not None:
+        if watch:
+            raise ValueError(
+                f"scenario {scenario.name} runs outside SimWorld and "
+                "cannot attach a fabric watcher")
         return asyncio.run(runner(seed))
-    return asyncio.run(_run(scenario, seed))
+    return asyncio.run(_run(scenario, seed, watch=watch))

@@ -1,0 +1,224 @@
+"""Fleet aggregation (obs.fleet), GET /v1/fleet, and the doctor --json
+contract.
+
+`aggregate()` is pure over captured per-node documents, so most of the
+matrix runs without any network: head spread, quorum margin against the
+group threshold, worst burn rate, suspect consensus, unreachable nodes,
+and the watcher-backed dispute check that stops a Byzantine node from
+poisoning the fleet head view with a claimed-but-unverified head.  The
+REST test serves a real 3-node sim network's documents through
+`build_fleet_app` and asserts the acceptance fields are populated.
+"""
+
+import json
+
+from drand_tpu.obs.fleet import FleetAggregator, aggregate, render_fleet
+
+
+def status_doc(head, expected, running=True, threshold=2, suspects=None):
+    return {
+        "chain": {"head_round": head, "expected_round": expected,
+                  "running": running, "threshold": threshold},
+        "suspects": suspects or [],
+    }
+
+
+def slo_doc(burn, remaining=0.8, name="gateway_verify"):
+    return {"time": 0, "objectives": {name: {
+        "budget_remaining": remaining,
+        "burn_rates": {"1h": burn},
+        "breaching": [],
+        "description": "",
+    }}}
+
+
+# -- pure aggregation -------------------------------------------------------
+
+
+def test_head_spread_quorum_and_lag():
+    doc = aggregate({
+        "a": {"status": status_doc(10, 10), "slo": None},
+        "b": {"status": status_doc(10, 10), "slo": None},
+        "c": {"status": status_doc(7, 10), "slo": None},
+    }, now=123.0)
+
+    assert doc["head"] == {"max": 10, "min": 7, "spread": 3}
+    # c trails the fleet max by >1 round: not part of the healthy set
+    assert doc["quorum"]["healthy"] == ["a", "b"]
+    assert doc["quorum"]["threshold"] == 2
+    assert doc["quorum"]["margin"] == 0
+    assert doc["nodes"]["c"]["lag"] == 3
+    assert doc["reachable"] == 3
+
+
+def test_unreachable_node_is_counted_out():
+    doc = aggregate({
+        "a": {"status": status_doc(5, 5), "slo": None},
+        "b": {"error": "connection refused"},
+    })
+    assert doc["reachable"] == 1
+    assert doc["nodes"]["b"]["reachable"] is False
+    assert doc["nodes"]["b"]["error"] == "connection refused"
+    assert doc["head"]["spread"] == 0  # only reachable heads count
+
+
+def test_worst_burn_and_min_budget_cross_node():
+    doc = aggregate({
+        "a": {"status": status_doc(5, 5), "slo": slo_doc(0.4)},
+        "b": {"status": status_doc(5, 5),
+              "slo": slo_doc(2.5, remaining=0.1)},
+    })
+    worst = doc["slo"]["worst_burn_rate"]
+    assert worst["node"] == "b" and worst["rate"] == 2.5
+    assert worst["window"] == "1h"
+    budget = doc["slo"]["min_budget_remaining"]
+    assert budget["node"] == "b" and budget["remaining"] == 0.1
+
+
+def test_suspect_consensus_needs_multiple_reporters_to_rank_first():
+    votes = [{"peer": "node9", "score": 4.0}]
+    doc = aggregate({
+        "a": {"status": status_doc(5, 5, suspects=list(votes)), "slo": None},
+        "b": {"status": status_doc(5, 5, suspects=[
+            {"peer": "node9", "score": 6.0}]), "slo": None},
+        "c": {"status": status_doc(5, 5, suspects=[
+            {"peer": "node3", "score": 9.0}]), "slo": None},
+    })
+    assert doc["suspects"][0] == {
+        "peer": "node9", "reported_by": ["a", "b"], "score": 5.0}
+    assert doc["suspects"][1]["peer"] == "node3"
+    assert doc["suspects"][1]["reported_by"] == ["c"]
+
+
+def test_watch_disputes_flag_unbacked_head_claims():
+    """A node that CLAIMS a head the watcher could not verify (beyond
+    one round of polling slack) becomes a dispute — the Byzantine node
+    cannot poison the fleet head view."""
+    watch = {"max_head": 8, "stalled": False, "forks": [],
+             "peers": {"a": {"head": 8}, "b": {"head": 5}}}
+    doc = aggregate({
+        "a": {"status": status_doc(9, 9), "slo": None},   # 9 <= 8+1: ok
+        "b": {"status": status_doc(12, 9), "slo": None},  # 12 > 5+1
+    }, watch=watch)
+
+    assert doc["watch"]["max_verified_head"] == 8
+    assert doc["watch"]["verified_heads"] == {"a": 8, "b": 5}
+    assert doc["watch"]["disputes"] == [
+        {"node": "b", "claimed_head": 12, "verified_head": 5}]
+    rendered = render_fleet(doc)
+    assert "DISPUTE b" in rendered
+
+
+def test_render_fleet_is_total_over_sparse_docs():
+    out = render_fleet(aggregate({"a": {"error": "nope"}}))
+    assert "UNREACHABLE" in out
+
+
+# -- REST: GET /v1/fleet over a sim network ---------------------------------
+
+
+async def test_fleet_endpoint_aggregates_three_node_sim_network():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from drand_tpu.net.rest import build_fleet_app
+    from drand_tpu.obs import slo as obs_slo
+    from drand_tpu.sim.harness import SimWorld
+    from drand_tpu.sim.scenario import _node_status
+
+    world = SimWorld(n=3, threshold=2, period=30.0, seed=3)
+    await world.start_all()
+    genesis = world.group.genesis_time
+    try:
+        # advance round by round, as the scenario runner does
+        for k in range(1, 5):
+            await world.advance_to(genesis + (k - 1) * 30.0 + 15.0)
+            await world.settle()
+
+        engine = obs_slo.SLOEngine(now_fn=world.clock.now)
+        engine.objective("round_finalize", target=0.9, threshold=1.0)
+        engine.record_bad("round_finalize")
+        engine.record_good("round_finalize")
+        node_slo = engine.snapshot()
+
+        def source_for(node):
+            async def src():
+                return {"status": _node_status(node, genesis, 30.0),
+                        "slo": node_slo}
+            return src
+
+        agg = FleetAggregator(
+            {n.address: source_for(n) for n in world.nodes},
+            now_fn=world.clock.now)
+        client = TestClient(TestServer(build_fleet_app(agg)))
+        await client.start_server()
+        try:
+            resp = await client.get("/v1/fleet")
+            assert resp.status == 200
+            doc = await resp.json()
+            assert len(doc["nodes"]) == 3
+            assert doc["reachable"] == 3
+            assert doc["head"]["spread"] is not None
+            assert doc["head"]["max"] >= 3
+            burn = doc["slo"]["worst_burn_rate"]
+            assert burn is not None and burn["rate"] > 0
+        finally:
+            await client.close()
+    finally:
+        await world.stop_all()
+
+
+async def test_fleet_aggregator_marks_raising_source_unreachable():
+    async def good():
+        return {"status": status_doc(4, 4), "slo": None}
+
+    async def boom():
+        raise ConnectionError("dial tcp: refused")
+
+    agg = FleetAggregator({"up": good, "down": boom}, now_fn=lambda: 1.0)
+    doc = await agg.poll()
+    assert doc["reachable"] == 1
+    assert doc["nodes"]["down"]["reachable"] is False
+    assert agg.last is doc
+
+
+# -- doctor --json: the stable machine contract -----------------------------
+
+
+def test_doctor_json_schema_and_exit_codes(monkeypatch, capsys):
+    from drand_tpu import cli
+
+    docs = {
+        "/v1/status": {
+            "chain": {"head_round": 5, "expected_round": 5,
+                      "running": True},
+            "suspects": [],
+        },
+        "/v1/slo": {"time": 0, "objectives": {}},
+        "/debug/flight": {"events": []},
+    }
+
+    def fake_get(url):
+        for suffix, doc in docs.items():
+            if url.endswith(suffix):
+                return doc
+        raise AssertionError(url)
+
+    monkeypatch.setattr(cli, "_http_get_json", fake_get)
+
+    rc = cli.main(["doctor", "--url", "http://x:1", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["schema"] == cli.DOCTOR_SCHEMA == "drand-tpu.doctor.v1"
+    assert doc["critical"] is False
+    assert doc["url"] == "http://x:1"
+    assert isinstance(doc["findings"], list)
+    for f in doc["findings"]:
+        assert set(f) >= {"severity", "kind", "summary"}
+
+    # a stalled chain is critical: same schema, exit 1
+    docs["/v1/status"]["chain"].update(head_round=1, expected_round=9)
+    rc = cli.main(["doctor", "--url", "http://x:1", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["critical"] is True
+    assert any(f["severity"] == "critical" for f in doc["findings"])

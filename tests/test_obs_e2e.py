@@ -105,6 +105,21 @@ async def test_round_trace_and_introspection_surface():
             resp = await client.get("/debug/traces?round=oops")
             assert resp.status == 400
 
+            # ?limit= pins the deterministic ordering contract: most
+            # recently updated trace first, exactly limit entries
+            resp = await client.get("/debug/traces?limit=1")
+            assert resp.status == 200
+            doc = await resp.json()
+            assert len(doc["traces"]) == 1
+            assert doc["traces"][0]["trace_id"] == \
+                trace.TRACER.recent(1)[0]["trace_id"]
+
+            resp = await client.get("/debug/traces?limit=0")
+            assert (await resp.json())["traces"] == []
+
+            resp = await client.get("/debug/traces?limit=oops")
+            assert resp.status == 400
+
             resp = await client.get("/debug/flight")
             assert resp.status == 200
             doc = json.loads(await resp.text())

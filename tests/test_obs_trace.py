@@ -80,6 +80,35 @@ def test_tracer_bounds_traces_and_spans():
     assert tr.dropped == 3
 
 
+def test_recent_orders_by_last_activity_and_respects_limit():
+    """`recent()` feeds `/debug/traces?limit=`: most-recently-UPDATED
+    trace first (a finished span moves its trace to the front), at most
+    n entries, and a non-positive limit is empty — this ordering is a
+    pinned contract, not an implementation detail."""
+    tr = Tracer(enabled=True)
+    for tid in ("t1", "t2", "t3"):
+        tr.span("first", trace_id=tid).finish()
+    tr.span("again", trace_id="t1").finish()  # t1 saw activity last
+
+    assert [t["trace_id"] for t in tr.recent(10)] == ["t1", "t3", "t2"]
+    assert [t["trace_id"] for t in tr.recent(2)] == ["t1", "t3"]
+    assert tr.recent(0) == []
+    assert tr.recent(-5) == []
+
+
+def test_tracer_sinks_can_be_removed():
+    tr = Tracer(enabled=True)
+    seen = []
+    sink = seen.append
+    tr.add_sink(sink)
+    tr.span("a").finish()
+    assert [d["name"] for d in seen] == ["a"]
+    tr.remove_sink(sink)
+    tr.remove_sink(sink)  # idempotent: removing twice must not raise
+    tr.span("b").finish()
+    assert [d["name"] for d in seen] == ["a"]
+
+
 def test_round_trace_id_is_deterministic():
     a = round_trace_id(b"seed", 5)
     assert a == round_trace_id(b"seed", 5)

@@ -420,29 +420,43 @@ async def test_rest_verify_endpoint_and_backpressure_mapping():
 
 
 async def test_rest_verify_returns_429_when_overloaded():
+    """A shed is never anonymous: the 429 body is JSON carrying the
+    reason and the request span's trace id, so the client can pull its
+    own trace from /debug/traces."""
     from aiohttp.test_utils import TestClient, TestServer
 
     from drand_tpu.net.rest import build_verify_app
+    from drand_tpu.obs import trace
 
+    prev = trace.TRACER.enabled
+    trace.TRACER.set_enabled(True)
     gate = threading.Event()
     scheme = StubScheme(gate)
-    async with gateway(scheme, max_queue=1) as gw:
-        client = TestClient(TestServer(build_verify_app(gw)))
-        await client.start_server()
-        try:
-            first = asyncio.ensure_future(gw.verify(req(1)))
-            await asyncio.sleep(0.05)  # kernel now blocked on the gate
-            # fill the queue, then the REST call must shed
-            filler = asyncio.ensure_future(gw.verify(req(2)))
-            await asyncio.sleep(0)
-            claim = {"round": 3, "previous_round": 2,
-                     "previous": ("01" * 96),
-                     "signature": (b"ok-three").hex()}
-            resp = await client.post("/v1/verify", json=claim)
-            assert resp.status == 429
-            assert resp.headers.get("Retry-After") == "1"
-            gate.set()
-            assert (await first).valid and (await filler).valid
-        finally:
-            gate.set()
-            await client.close()
+    try:
+        async with gateway(scheme, max_queue=1) as gw:
+            client = TestClient(TestServer(build_verify_app(gw)))
+            await client.start_server()
+            try:
+                first = asyncio.ensure_future(gw.verify(req(1)))
+                await asyncio.sleep(0.05)  # kernel blocked on the gate
+                # fill the queue, then the REST call must shed
+                filler = asyncio.ensure_future(gw.verify(req(2)))
+                await asyncio.sleep(0)
+                claim = {"round": 3, "previous_round": 2,
+                         "previous": ("01" * 96),
+                         "signature": (b"ok-three").hex()}
+                resp = await client.post("/v1/verify", json=claim)
+                assert resp.status == 429
+                assert resp.headers.get("Retry-After") == "1"
+                assert resp.content_type == "application/json"
+                body = await resp.json()
+                assert body["error"] == "overloaded"
+                tid = body["trace_id"]
+                assert trace.TRACER.get_trace(tid) is not None
+                gate.set()
+                assert (await first).valid and (await filler).valid
+            finally:
+                gate.set()
+                await client.close()
+    finally:
+        trace.TRACER.set_enabled(prev)

@@ -1,0 +1,300 @@
+"""External chain watchdog (obs.watch): the untrusted-third-party view.
+
+The watcher's contract is that NOTHING a peer merely claims enters its
+world view — every beacon must pass the pairing check — and that fork /
+stall / lag conditions edge-trigger exactly one typed event each.  Unit
+tests drive a `ChainWatcher` over stub fetchers with a fake scheme whose
+verification is a keyed hash (so forgeries and fork branches are cheap
+to mint); the integration test attaches the watcher to the `fork_stall`
+sim scenario and checks it names the known divergence round and flags
+the stall within the promised window, with zero in-node cooperation.
+"""
+
+import hashlib
+import json
+import os
+
+from drand_tpu.beacon.chain import Beacon, beacon_message
+from drand_tpu.obs.watch import ChainWatcher
+
+DIST_KEY = b"watch-test-group-key"
+GENESIS_SEED = b"\xaa" * 48
+GENESIS_TIME = 1000
+PERIOD = 30.0
+
+
+class FakeScheme:
+    """Signature = H(dist_key || msg) plus free trailing bytes.
+
+    The trailing freedom lets a test mint two DIFFERENT valid beacons
+    for the same round (a same-round fork) without touching pairings.
+    """
+
+    def __init__(self):
+        self.batches = 0
+
+    def verify_chain_batch(self, dist_key, msgs, sigs):
+        self.batches += 1
+        return [s[:32] == hashlib.sha256(dist_key + m).digest()
+                for m, s in zip(msgs, sigs)]
+
+
+def sign(msg: bytes, salt: bytes = b"") -> bytes:
+    return hashlib.sha256(DIST_KEY + msg).digest() + salt
+
+
+def mk_beacon(round_, prev=None, *, prev_round=None, prev_sig=None,
+              salt=b"", signature=None) -> Beacon:
+    if prev is not None:
+        prev_round, prev_sig = prev.round, prev.signature
+    if prev_round is None:
+        prev_round, prev_sig = 0, GENESIS_SEED
+    msg = beacon_message(prev_sig, prev_round, round_)
+    return Beacon(round=round_, prev_round=prev_round, prev_sig=prev_sig,
+                  signature=(signature if signature is not None
+                             else sign(msg, salt)))
+
+
+def mk_chain(n: int):
+    out, prev = [], None
+    for r in range(1, n + 1):
+        b = mk_beacon(r, prev)
+        out.append(b)
+        prev = b
+    return out
+
+
+def list_source(store):
+    """Fetcher over a mutable list of beacons (append to extend)."""
+    async def fetch(from_round):
+        return [b for b in store if b.round >= from_round]
+    return fetch
+
+
+class StubClock:
+    def __init__(self, t=float(GENESIS_TIME)):
+        self.t = t
+
+    def now(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_watcher(sources, clock=None, **kw):
+    return ChainWatcher(
+        DIST_KEY, FakeScheme(), period=PERIOD, genesis_time=GENESIS_TIME,
+        sources=sources, clock=clock or StubClock(), **kw)
+
+
+def kinds(watcher):
+    return [e["kind"] for e in watcher.events]
+
+
+# -- follow / verify --------------------------------------------------------
+
+
+async def test_follows_and_verifies_peers_batched():
+    chain = mk_chain(5)
+    a, b = list(chain), list(chain[:3])
+    w = make_watcher({"a": list_source(a), "b": list_source(b)})
+    snap = await w.poll()
+
+    assert w.heads() == {"a": 5, "b": 3}
+    assert snap["max_head"] == 5
+    assert snap["forks"] == []
+    assert snap["peers"]["b"]["lag"] == 2
+    # one pairing batch per peer, not per beacon
+    assert w.scheme.batches == 2
+    # b trails by lag_rounds -> edge event; then catches up
+    assert kinds(w).count("watch_head_lag") == 1
+    b.extend(chain[3:])
+    await w.poll()
+    assert w.heads()["b"] == 5
+    assert "watch_catchup" in kinds(w)
+    assert "watch_caught_up" in kinds(w)
+
+
+async def test_unreachable_peer_edge_events():
+    chain = mk_chain(2)
+    calls = {"fail": True}
+
+    async def flaky(from_round):
+        if calls["fail"]:
+            raise ConnectionError("peer down")
+        return [b for b in chain if b.round >= from_round]
+
+    w = make_watcher({"a": flaky})
+    await w.poll()
+    await w.poll()
+    # edge-triggered: one unreachable event across repeated failures
+    assert kinds(w).count("watch_peer_unreachable") == 1
+    assert w.snapshot()["peers"]["a"]["status"] == "unreachable"
+    calls["fail"] = False
+    await w.poll()
+    assert kinds(w).count("watch_peer_ok") == 1
+    assert w.heads()["a"] == 2
+
+
+# -- trust boundary ---------------------------------------------------------
+
+
+async def test_forged_beacon_rejected_and_truncates():
+    chain = mk_chain(2)
+    forged = mk_beacon(3, chain[-1], signature=b"\x00" * 96)
+    # rounds 4..5 chain onto the forgery: they must die with it
+    tail4 = mk_beacon(4, forged)
+    tail5 = mk_beacon(5, tail4)
+    w = make_watcher({"a": list_source(chain + [forged, tail4, tail5])})
+    await w.poll()
+
+    assert w.heads()["a"] == 2, "nothing past the forgery may verify"
+    assert w.snapshot()["peers"]["a"]["bad"] >= 1
+    bad = [e for e in w.events if e["kind"] == "watch_bad_beacon"]
+    assert bad and bad[0]["round"] == 3
+    assert w.forks == [], "a forgery is rejected, not a fork"
+
+
+async def test_stale_head_liar_cannot_inflate_verified_heads():
+    """A Byzantine peer can claim any head it likes; only what passes
+    the pairing check lands in heads() — so at worst it under-reports."""
+    chain = mk_chain(4)
+    fake9 = mk_beacon(9, prev_round=4, prev_sig=chain[-1].signature,
+                      signature=b"\xff" * 96)
+    w = make_watcher({"honest": list_source(chain),
+                      "liar": list_source(chain + [fake9])})
+    snap = await w.poll()
+
+    assert w.heads() == {"honest": 4, "liar": 4}
+    assert snap["max_head"] == 4
+    assert snap["forks"] == []
+    assert any(e["kind"] == "watch_bad_beacon" and e["peer"] == "liar"
+               for e in w.events)
+
+
+# -- fork detection ---------------------------------------------------------
+
+
+async def test_bridging_fork_names_divergence_round_edge_triggered():
+    """The fork_stall shape in miniature: one peer finalizes round 6,
+    the other's chain bridges 5->7 over it.  The divergence round is 6,
+    reported exactly once no matter how often the watcher polls."""
+    chain = mk_chain(6)
+    branch7 = mk_beacon(7, chain[4])  # prev_round=5: bridges over 6
+    w = make_watcher({"a": list_source(chain),
+                      "b": list_source(chain[:5] + [branch7])})
+    await w.poll()
+    await w.poll()
+    await w.poll()
+
+    assert [(f["peer"], f["divergence_round"]) for f in w.forks] == \
+        [("b", 6)]
+    assert kinds(w).count("watch_fork") == 1
+    # the branch itself verified: b's head advanced onto it
+    assert w.heads()["b"] == 7
+
+
+async def test_same_round_conflict_is_a_fork():
+    chain = mk_chain(3)
+    alt3 = mk_beacon(3, chain[1], salt=b"fork")  # valid, different sig
+    w = make_watcher({"a": list_source(chain),
+                      "b": list_source(chain[:2] + [alt3])})
+    await w.poll()
+
+    assert [(f["peer"], f["divergence_round"]) for f in w.forks] == \
+        [("b", 3)]
+
+
+# -- stall detection --------------------------------------------------------
+
+
+async def test_stall_flags_after_idle_periods_then_resumes():
+    chain = mk_chain(2)
+    store = list(chain)
+    clock = StubClock(GENESIS_TIME + 75.0)
+    w = make_watcher({"a": list_source(store)}, clock=clock,
+                     stall_periods=3)
+    await w.poll()
+    assert not w.stalled
+
+    clock.advance(4 * PERIOD)  # idle 120s, schedule 5 rounds ahead
+    await w.poll()
+    await w.poll()
+    assert w.stalled
+    assert kinds(w).count("watch_stalled") == 1
+    stall = next(e for e in w.events if e["kind"] == "watch_stalled")
+    assert stall["head"] == 2 and stall["behind"] >= 2
+
+    store.extend(mk_chain(8)[2:])  # chain marches on again
+    await w.poll()
+    assert not w.stalled
+    assert kinds(w).count("watch_resumed") == 1
+
+
+# -- sim integration --------------------------------------------------------
+
+
+def test_fork_stall_watcher_reports_divergence_and_stall():
+    """Acceptance: on the fork_stall scenario the attached watcher must
+    name the known divergence round AND flag the stall within 3 beacon
+    periods — purely by fetching and verifying chains over the fabric,
+    with no in-node cooperation."""
+    from drand_tpu.sim.scenario import run_scenario
+
+    report = run_scenario("fork_stall", seed=7, watch=True)
+    assert report.passed, report.failures
+    w = report.watch
+    assert w is not None
+    assert w["stalled"] is True
+    assert [(f["peer"], f["divergence_round"]) for f in w["forks"]] == \
+        [("sim01", 6)]
+
+    doc = json.loads(report.event_log)
+    events = doc["events"] if isinstance(doc, dict) else doc
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e["kind"], []).append(e)
+    assert "watch_fork" in by_kind and "watch_stalled" in by_kind
+    fork = by_kind["watch_fork"][0]
+    assert fork["peer"] == "sim01" and fork["divergence_round"] == 6
+
+    genesis = by_kind["sim_start"][0]["genesis"]
+    period = 30.0
+    # last finalized round is 7; the stall must be flagged within 3
+    # periods of its schedule slot
+    stall = by_kind["watch_stalled"][0]
+    assert stall["ts"] <= genesis + (7 + 3) * period
+    # the merged timeline carries per-node handler spans too
+    assert any(e["kind"] == "node_span" for e in events)
+
+
+def test_cli_sim_inspect_renders_committed_timeline(capsys):
+    from drand_tpu import cli
+
+    path = os.path.join(os.path.dirname(__file__), "data",
+                        "fork_stall_watch_events.json")
+    rc = cli.main(["sim", "inspect", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "watch_fork" in out and "watch_stalled" in out
+    assert "sim_start" in out and "sim_end" in out
+
+    rc = cli.main(["sim", "inspect", path, "--round", "6"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # the starred watcher row names the divergence
+    assert "divergence_round=6" in out
+    assert any(line.startswith("*") and "watch_fork" in line
+               for line in out.splitlines())
+    assert "offsets relative to genesis" in out
+
+
+def test_cli_sim_inspect_rejects_garbage(tmp_path, capsys):
+    from drand_tpu import cli
+
+    bad = tmp_path / "not_events.json"
+    bad.write_text(json.dumps({"nope": 1}))
+    rc = cli.main(["sim", "inspect", str(bad)])
+    capsys.readouterr()
+    assert rc == 1
