@@ -49,6 +49,14 @@ If the ambient accelerator backend is broken (the axon TPU tunnel can
 either raise at init or hang indefinitely — BENCH_r02 recorded rc=1 with
 no parseable output), the bench re-execs itself with JAX_PLATFORMS=cpu
 and a small batch so a real, honest number is always recorded.
+
+Every artifact carries a `detail.lineage` block (obs.perf.lineage,
+schema drand-tpu.lineage.v1): git revision, backend, device, whitelisted
+env knobs, and — when a record came out of a retry or fallback —
+`degraded: true` with `degraded_reason: "infra" | "code"` saying whether
+infrastructure (tunnel, backend, fault-signal retry) or the measured
+code path was at fault.  `cli bench diff` gates regressions on these
+artifacts.
 """
 
 import json
@@ -100,6 +108,9 @@ def _supervise() -> None:
                       "retrying with the XLA cache disabled",
         }), flush=True)
         env["DRAND_TPU_XLA_CACHE"] = "off"
+        # a fault-signal retry is an infrastructure degradation: the
+        # retried record must say so in its lineage block
+        env["BENCH_DEGRADED_REASON"] = "infra"
         r = subprocess.run([sys.executable] + sys.argv, env=env)
     sys.exit(r.returncode)
 
@@ -304,7 +315,24 @@ def _bench_round_finalize() -> dict:
     }
 
 
-def main() -> None:
+def _lineage(degraded_reason=None, backend=None, device=None) -> dict:
+    """Provenance block for the artifact (obs.perf.lineage): git rev,
+    backend, env knobs, and WHY a record is degraded — `infra` (broken
+    tunnel, fault-signal retry, CPU fallback) vs `code` (a real failure
+    in the measured path).  `bench diff` prints it so a regression
+    report always says what produced the numbers."""
+    from drand_tpu.obs import perf
+
+    reason = degraded_reason or os.environ.get("BENCH_DEGRADED_REASON")
+    if os.environ.get("BENCH_FALLBACK") == "1" and reason is None:
+        reason = "infra"  # dead ambient backend forced the CPU re-exec
+    return perf.lineage(
+        backend=backend, device=device,
+        degraded=reason is not None, degraded_reason=reason,
+    )
+
+
+def main(degraded_reason=None) -> None:
     import jax
     import jax.numpy as jnp
 
@@ -438,6 +466,11 @@ def main() -> None:
             "est_1M_rounds_seconds": round(1_000_000 / rounds_per_sec, 1),
             "round_finalize": finalize_detail,
             "partial_ingest": ingest_detail,
+            "lineage": _lineage(
+                degraded_reason=degraded_reason,
+                backend=jax.default_backend(),
+                device=str(jax.devices()[0]),
+            ),
         },
     }))
 
@@ -451,19 +484,30 @@ if __name__ == "__main__":
         except Exception as first:  # noqa: BLE001
             # the experimental TPU tunnel can drop a single dispatch
             # mid-run; one retry distinguishes that flake from a real
-            # failure without masking persistent breakage
-            print(f"bench: first attempt failed "
-                  f"({type(first).__name__}: {str(first)[:200]}); "
+            # failure without masking persistent breakage.  The retried
+            # record is degraded — classify the first failure so the
+            # lineage says whether infra or code was at fault.
+            from drand_tpu.obs import perf as _perf
+
+            first_text = "%s: %s" % (type(first).__name__, str(first))
+            print(f"bench: first attempt failed ({first_text[:200]}); "
                   f"retrying once", file=sys.stderr, flush=True)
             time.sleep(5.0)
-            main()
+            main(degraded_reason=_perf.classify_failure(first_text))
     except Exception as e:  # noqa: BLE001 — always emit the JSON line
+        err_text = "%s: %s" % (type(e).__name__, str(e))
+        try:
+            from drand_tpu.obs import perf as _perf
+            lineage = _lineage(
+                degraded_reason=_perf.classify_failure(err_text))
+        except Exception:  # noqa: BLE001 — lineage must not mask the error
+            lineage = None
         print(json.dumps({
             "metric": "beacon-chain batch-verify throughput, incl. "
                       "hash-to-curve (BLS12-381 pairings/sec/chip)",
             "value": 0.0,
             "unit": "pairings/sec/chip",
             "vs_baseline": 0.0,
-            "detail": {"error": "%s: %s" % (type(e).__name__, str(e)[:400])},
+            "detail": {"error": err_text[:400], "lineage": lineage},
         }))
         sys.exit(1)

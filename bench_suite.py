@@ -76,6 +76,15 @@ def _persist() -> None:
     except Exception:
         pass
     try:
+        from bench import _lineage
+
+        payload["lineage"] = _lineage(
+            backend=payload.get("backend"),
+            device=payload.get("device"),
+        )
+    except Exception:
+        pass
+    try:
         out = _suite_outfile()
         out.write_text(json.dumps(payload, indent=1) + "\n")
         print(json.dumps({"config": "_written", "path": out.name}),

@@ -46,7 +46,9 @@ from drand_tpu.net.interface import (  # noqa: F401
     ProtocolClient,
 )
 from drand_tpu.key import Group, Identity, Share
+from drand_tpu.obs import kernels as obs_kernels
 from drand_tpu.obs import peers as obs_peers
+from drand_tpu.obs import perf as obs_perf
 from drand_tpu.obs import slo as obs_slo
 from drand_tpu.obs import trace as obs_trace
 from drand_tpu.utils import metrics
@@ -101,6 +103,21 @@ GOSSIP_RETRY_DELAY = 0.1
 #: optimistic finalize: bounded blame/evict/retry rounds before the
 #: quorum is declared unrecoverable and the attempt abandoned
 FINALIZE_ATTEMPTS = 8
+
+
+def _counted(fn, *args):
+    """Run `fn` and return `(result, device-dispatch delta)`.
+
+    The delta is measured synchronously around the call — inside the
+    offload runner, against the CALLING THREAD's dispatch counter — so
+    it is exact under the simulator's inline runner and in production,
+    and stays exact when several handlers share one process (their
+    concurrent finalizes dispatch from different threads).  This is
+    what feeds the perf observatory's dispatch-budget sentinel.
+    """
+    before = obs_kernels.thread_dispatches()
+    out = fn(*args)
+    return out, obs_kernels.thread_dispatches() - before
 
 
 @dataclass
@@ -458,11 +475,18 @@ class BeaconHandler:
                        "fused": True,
                        "node": self.cfg.public.address},
             ):
-                return await self._offload(
-                    self.scheme.finalize_round,
+                sig, spent = await self._offload(
+                    _counted, self.scheme.finalize_round,
                     self.pub_poly, msg, list(partials.values()),
                     t, len(self.group),
                 )
+                # eager mode has no <=2 contract: account the round but
+                # keep it exempt from the budget sentinel
+                obs_perf.note_round(round, spent, fallback=True,
+                                    now=self.clock.now())
+                return sig
+        spent = 0
+        used_fallback = False
         for attempt in range(FINALIZE_ATTEMPTS):
             # refill after evictions; the manager's standby buffer may
             # already hold another sender's copy of an evicted index.
@@ -479,17 +503,28 @@ class BeaconHandler:
                        "node": self.cfg.public.address},
             ):
                 try:
-                    return await self._offload(
-                        self.scheme.finalize_round_optimistic,
+                    sig, d = await self._offload(
+                        _counted, self.scheme.finalize_round_optimistic,
                         self.pub_poly, msg, list(partials.values()),
                         t, len(self.group),
                     )
+                    # dispatch-budget sentinel: an HONEST finalize (no
+                    # blame fallback) must fit the <=2-dispatch budget;
+                    # fallback retries legitimately re-dispatch and are
+                    # accounted but exempt from the alarm
+                    obs_perf.note_round(
+                        round, spent + d, fallback=used_fallback,
+                        now=self.clock.now(),
+                    )
+                    return sig
                 except tbls.ThresholdError:
+                    used_fallback = True
                     _optimistic_fallbacks.inc()
-                    ok = await self._offload(
-                        self.scheme.verify_partials_batch,
+                    ok, d = await self._offload(
+                        _counted, self.scheme.verify_partials_batch,
                         self.pub_poly, msg, list(partials.values()),
                     )
+                    spent += d
                     bad = [i for i, good in zip(list(partials), ok)
                            if not good]
                     if not bad:

@@ -30,6 +30,9 @@ and daemon.go/control.go/public.go):
                                            forks/stalls/lag
   drand-tpu sim run|list|inspect           deterministic chaos scenarios
                                            + merged timeline viewer
+  drand-tpu bench diff OLD NEW             stage-by-stage bench artifact
+                                           comparison; exits 1 on
+                                           regression (CI gate)
 
 Run as `python -m drand_tpu.cli ...`.
 """
@@ -679,6 +682,36 @@ def diagnose(status, slo_doc, flight_events) -> list:
                     f"{steady * 1e3:.1f}ms steady-state — cold XLA "
                     "compile; pre-warm with `drand-tpu warmup`")
 
+    # -- performance observatory ------------------------------------------
+    perf_doc = status.get("perf") or {}
+    rounds = perf_doc.get("rounds") or {}
+    if rounds.get("breaching"):
+        add("critical", "dispatch_budget_regression",
+            f"honest rounds are exceeding the dispatch budget: last "
+            f"round spent {rounds.get('last_dispatches')} device "
+            f"dispatches (budget {rounds.get('budget')})",
+            f"{rounds.get('exceeded_total', 0)} offense(s) over "
+            f"{rounds.get('episodes', 0)} episode(s) — the optimistic "
+            "finalize path is doing extra device work; check for a "
+            "scheme regression or silent fallback re-verification")
+    recompiles = perf_doc.get("recompiles") or {}
+    if recompiles.get("storm"):
+        add("warning", "recompile_storm",
+            f"{recompiles.get('recent')} suspected jit recompile(s) in "
+            f"the last {recompiles.get('window_seconds')}s",
+            "dispatches are hitting fresh XLA compiles outside warmup — "
+            "look for unstable shapes or a cold/dropped compile cache")
+    for op, st in sorted((perf_doc.get("kernels") or {}).items()):
+        p50, p99 = st.get("p50"), st.get("p99")
+        if st.get("count", 0) >= 50 and p50 and p99 \
+                and p99 > max(10 * p50, 0.001):
+            add("warning", "kernel_latency_regression",
+                f"kernel {op}: p99 {p99 * 1e3:.1f}ms is "
+                f"{p99 / p50:.0f}x its p50 {p50 * 1e3:.1f}ms over "
+                f"{st['count']} dispatches",
+                "heavy-tailed kernel latency — host contention, "
+                "recompiles, or an input-dependent slow path")
+
     # -- flight recorder -------------------------------------------------
     crashes = [e for e in flight_events
                if e.get("kind") in ("crash", "signal")]
@@ -918,6 +951,60 @@ def _render_watch_event(ev: dict) -> str:
     rest = " ".join(f"{k}={ev[k]}" for k in sorted(ev)
                     if k not in ("kind", "ts"))
     return f"[{ev.get('ts', 0):.0f}] {ev['kind']}: {rest}"
+
+
+def cmd_bench_diff(args) -> int:
+    """Compare two bench artifacts stage by stage and gate on
+    regressions (obs.perf.diff_stages): latency/throughput stages fail
+    beyond --tolerance, dispatch counts fail on ANY increase — they are
+    backend-independent, so a third dispatch on CPU means a third
+    dispatch on TPU.  --warn-only downgrades latency/throughput
+    regressions to warnings (for noisy CI hosts) but still fails on
+    dispatch regressions."""
+    import json
+
+    from drand_tpu.obs import perf
+
+    try:
+        old_doc = perf.load_artifact(args.old)
+        new_doc = perf.load_artifact(args.new)
+    except (OSError, ValueError) as exc:
+        print(f"bench diff: {exc}", file=sys.stderr)
+        return 2
+    rows = perf.diff_stages(perf.extract_stages(old_doc),
+                            perf.extract_stages(new_doc),
+                            tolerance=args.tolerance)
+    regressions = [r for r in rows if r["verdict"] == "regression"]
+    hard = [r for r in regressions
+            if not args.warn_only or r["kind"] == "dispatch"]
+    if args.json:
+        print(json.dumps({
+            "schema": "drand-tpu.bench-diff.v1",
+            "old": args.old,
+            "new": args.new,
+            "tolerance": args.tolerance,
+            "regression": bool(hard),
+            "rows": rows,
+        }, indent=2, sort_keys=True))
+    else:
+        for r in rows:
+            delta = ("" if r["delta_pct"] is None
+                     else f"{r['delta_pct']:+7.1f}%")
+            mark = {"regression": "!!", "improved": "++"}.get(
+                r["verdict"], "  ")
+            print(f"{mark} {r['verdict']:10s} {r['stage']:44s} "
+                  f"{r['old']} -> {r['new']}  {delta}")
+        lineage = (new_doc.get("lineage")
+                   or (new_doc.get("detail") or {}).get("lineage"))
+        if lineage:
+            print(f"-- new artifact: backend={lineage.get('backend')} "
+                  f"device={lineage.get('device')} "
+                  f"rev={lineage.get('git_rev')} "
+                  f"degraded={lineage.get('degraded')}")
+        print(f"-- {len(rows)} stage(s), {len(regressions)} "
+              f"regression(s)"
+              + (f" ({len(hard)} gating)" if args.warn_only else ""))
+    return 1 if hard else 0
 
 
 def cmd_sim_inspect(args) -> int:
@@ -1288,6 +1375,30 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--json", action="store_true",
                    help="print watch events as JSON lines")
     g.set_defaults(fn=cmd_watch)
+
+    g = sub.add_parser(
+        "bench",
+        help="benchmark artifact tooling (regression gating)",
+    )
+    bench_sub = g.add_subparsers(dest="bench_cmd", required=True)
+
+    b = bench_sub.add_parser(
+        "diff",
+        help="compare two bench artifacts; exit 1 on regression",
+    )
+    b.add_argument("old", help="baseline artifact (JSON / JSONL)")
+    b.add_argument("new", help="candidate artifact (JSON / JSONL)")
+    b.add_argument("--tolerance", type=float, default=0.25,
+                   help="allowed fractional slip for latency/throughput "
+                        "stages (default 0.25); dispatch counts always "
+                        "gate at zero tolerance")
+    b.add_argument("--warn-only", action="store_true",
+                   help="report latency/throughput regressions without "
+                        "failing (noisy CI hosts); dispatch regressions "
+                        "still fail")
+    b.add_argument("--json", action="store_true",
+                   help="machine-readable diff document")
+    b.set_defaults(fn=cmd_bench_diff)
 
     g = sub.add_parser(
         "sim",

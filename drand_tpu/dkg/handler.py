@@ -15,6 +15,8 @@ Mirrors /root/reference/dkg/dkg.go behavior:
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -27,6 +29,7 @@ from drand_tpu.dkg.pedersen import (
 )
 from drand_tpu.key import Group, Identity, Pair, Share
 from drand_tpu.obs import trace as obs_trace
+from drand_tpu.utils import metrics
 from drand_tpu.utils.clock import Clock
 
 from drand_tpu.utils.logging import get_logger
@@ -88,6 +91,10 @@ class DKGHandler:
         )
         self._timer_task: Optional[asyncio.Task] = None
         self._lock = asyncio.Lock()
+        #: per-phase wall-time accounting (deal verification is the
+        #: slowest protocol phase — ROADMAP direction 3 batches it);
+        #: surfaced in /v1/status and the drand_dkg_phase_seconds metric
+        self.phase_seconds: Dict[str, dict] = {}
 
     def _span(self, name: str, **attrs):
         """Per-phase span inside this DKG run's distributed trace."""
@@ -95,6 +102,32 @@ class DKGHandler:
         return obs_trace.TRACER.span(
             name, trace_id=self._trace_id, attrs=attrs
         )
+
+    @contextlib.contextmanager
+    def _phase(self, name: str, **attrs):
+        """`_span` plus phase timing: accumulates into `phase_seconds`
+        and the per-phase histogram even when tracing is off."""
+        with self._span(name, **attrs) as span:
+            t0 = time.perf_counter()
+            try:
+                yield span
+            finally:
+                dt = time.perf_counter() - t0
+                phase = name.split(".", 1)[-1]
+                st = self.phase_seconds.setdefault(phase, {
+                    "count": 0, "seconds_total": 0.0, "max_seconds": 0.0,
+                    "last_seconds": 0.0,
+                })
+                st["count"] += 1
+                st["seconds_total"] += dt
+                st["max_seconds"] = max(st["max_seconds"], dt)
+                st["last_seconds"] = dt
+                metrics.histogram(
+                    "drand_dkg_phase_seconds",
+                    "Wall time of DKG protocol phases (deal generation/"
+                    "verification, responses, justifications, finalize)",
+                    labels={"phase": phase},
+                ).observe(dt)
 
     # -- control ----------------------------------------------------------
 
@@ -113,7 +146,7 @@ class DKGHandler:
             if self._sent_deals or not self.dkg.is_dealer:
                 return
             self._sent_deals = True
-        with self._span("dkg.deal_out") as span:
+        with self._phase("dkg.deal_out") as span:
             deals = self.dkg.deals()
             span.set_attr("deals", len(deals))
             for deal in deals:
@@ -174,7 +207,7 @@ class DKGHandler:
         self._arm_timer()
         await self._send_deals()
         if "dkg_deal" in packet:
-            with self._span("dkg.deal"):
+            with self._phase("dkg.deal"):
                 deal = Deal.from_dict(packet["dkg_deal"])
                 try:
                     resp = self.dkg.process_deal(deal)
@@ -183,7 +216,7 @@ class DKGHandler:
                     return
                 await self._broadcast_response(resp)
         elif "dkg_response" in packet:
-            with self._span("dkg.response"):
+            with self._phase("dkg.response"):
                 try:
                     self.dkg.process_response(
                         Response.from_dict(packet["dkg_response"])
@@ -197,7 +230,7 @@ class DKGHandler:
                 await self._broadcast_justifications()
                 self._check_done()
         elif "dkg_justification" in packet:
-            with self._span("dkg.justification"):
+            with self._phase("dkg.justification"):
                 try:
                     self.dkg.process_justification(
                         Justification.from_dict(
@@ -252,7 +285,7 @@ class DKGHandler:
         self._done = True
         if self._timer_task is not None:
             self._timer_task.cancel()
-        with self._span("dkg.finalize") as span:
+        with self._phase("dkg.finalize") as span:
             try:
                 if self.dkg.index is None:
                     # old-only node in a reshare: participates as dealer

@@ -208,9 +208,9 @@ def _profile_authorized(request) -> bool:
 def _add_obs_routes(routes: web.RouteTableDef, status_fn,
                     slo_fn=None) -> None:
     """Introspection surface shared by both apps: health JSON, SLO
-    document, recent traces, the live flight-recorder buffer and
-    on-demand device profiling."""
-    from drand_tpu.obs import flight, profile, slo, trace
+    document, perf baselines, recent traces, the live flight-recorder
+    buffer and on-demand device profiling."""
+    from drand_tpu.obs import flight, perf, profile, slo, trace
 
     @routes.get("/v1/status")
     async def status(request):
@@ -220,6 +220,12 @@ def _add_obs_routes(routes: web.RouteTableDef, status_fn,
     async def slo_doc(request):
         fn = slo_fn or slo.ENGINE.snapshot
         return web.json_response(fn())
+
+    @routes.get("/v1/perf")
+    async def perf_doc(request):
+        # streaming per-stage/per-kernel latency baselines + per-round
+        # dispatch accounting (the /v1/status "perf" section, standalone)
+        return web.json_response(perf.snapshot(), dumps=_dumps_repr)
 
     @routes.post("/debug/profile")
     async def profile_start(request):

@@ -19,6 +19,10 @@ The triad any serving stack needs before it can be operated:
   missed/invalid partials, clock-skew estimates and suspect ranking.
 * `obs.profile` — single-flight on-demand device profiling behind
   `POST /debug/profile`.
+* `obs.perf`    — performance observatory: streaming per-stage/kernel
+  latency quantiles, per-round dispatch accounting, the dispatch-budget
+  sentinel (honest round <= 2 dispatches) and bench lineage/diff
+  helpers, served at `GET /v1/perf`.
 * `obs.watch`   — external chain watchdog: follow nodes as an untrusted
   third party, verify every fetched beacon against the distributed key,
   edge-trigger fork/stall/lag events (`drand_watch_*` metrics).
@@ -37,6 +41,7 @@ feather-weight on the protocol import path.
 from drand_tpu.obs.flight import RECORDER, FlightRecorder, install_crash_handler
 from drand_tpu.obs.kernels import block, kernel_span
 from drand_tpu.obs.peers import PeerLedger
+from drand_tpu.obs.perf import OBSERVATORY, PerfObservatory
 from drand_tpu.obs.profile import CAPTURE, ProfileCapture
 from drand_tpu.obs.slo import (
     ENGINE,
@@ -60,8 +65,10 @@ __all__ = [
     "ENGINE",
     "FlightRecorder",
     "NOOP_SPAN",
+    "OBSERVATORY",
     "Objective",
     "PeerLedger",
+    "PerfObservatory",
     "ProfileCapture",
     "RECORDER",
     "ROUND_FINALIZE",
@@ -92,3 +99,9 @@ def _span_to_flight(span_dict: dict) -> None:
 # finished spans become flight-recorder events, so a crash dump carries
 # the recent span history even though the tracer itself is in-memory
 TRACER.add_sink(_span_to_flight)
+
+# pipeline-stage spans (beacon.*, dkg.*, gateway.*) also feed the
+# performance observatory's streaming latency baselines (GET /v1/perf)
+from drand_tpu.obs import perf as _perf  # noqa: E402
+
+TRACER.add_sink(_perf.span_sink)

@@ -38,6 +38,12 @@ _burn_gauge = metrics.gauge(
     "worst SLO long-window burn rate across the fleet")
 _reach_gauge = metrics.gauge(
     "drand_fleet_nodes_reachable", "nodes that answered the last poll")
+_worst_p99_gauge = metrics.gauge(
+    "drand_fleet_worst_stage_p99_seconds",
+    "worst per-stage p99 latency across reachable fleet nodes")
+_budget_breach_gauge = metrics.gauge(
+    "drand_fleet_dispatch_budget_breaching",
+    "fleet nodes currently breaching their round dispatch budget")
 
 
 def _worst_burn(slo_doc: Optional[dict]) -> Optional[dict]:
@@ -84,6 +90,11 @@ def aggregate(node_docs: Dict[str, dict], watch: Optional[dict] = None,
     heads, healthy, threshold = {}, [], None
     worst_burn, min_budget = None, None
     suspect_votes: Dict[str, list] = {}
+    # perf observatory fold: worst per-stage p99 across the fleet, plus
+    # dispatch-budget sentinel state (who is breaching, total overruns)
+    worst_stages: Dict[str, dict] = {}
+    budget_breaching: list = []
+    budget_exceeded_total = 0
 
     for name in sorted(node_docs):
         doc = node_docs[name] or {}
@@ -112,6 +123,24 @@ def aggregate(node_docs: Dict[str, dict], watch: Optional[dict] = None,
             if peer:
                 suspect_votes.setdefault(peer, []).append(
                     (name, s.get("score")))
+
+        perf_doc = (status or {}).get("perf") or {}
+        for kind in ("stages", "kernels"):
+            for stage, est in sorted((perf_doc.get(kind) or {}).items()):
+                p99 = est.get("p99") if isinstance(est, dict) else None
+                if not isinstance(p99, (int, float)):
+                    continue
+                key = stage if kind == "stages" else f"kernel.{stage}"
+                cur = worst_stages.get(key)
+                if cur is None or p99 > cur["p99"]:
+                    worst_stages[key] = {
+                        "p99": p99, "node": name,
+                        "count": est.get("count"),
+                    }
+        rounds = perf_doc.get("rounds") or {}
+        if rounds.get("breaching"):
+            budget_breaching.append(name)
+        budget_exceeded_total += int(rounds.get("exceeded_total") or 0)
 
         findings = diagnose(status, slo_doc, []) if status else []
         nodes[name] = {
@@ -165,6 +194,16 @@ def aggregate(node_docs: Dict[str, dict], watch: Optional[dict] = None,
         },
         "slo": {"worst_burn_rate": worst_burn,
                 "min_budget_remaining": min_budget},
+        "perf": {
+            # worst per-stage p99 across the fleet: the node dragging
+            # each stage down is named so `cli fleet` can point at it
+            "worst_stage_p99": {k: worst_stages[k]
+                                for k in sorted(worst_stages)},
+            "dispatch_budget": {
+                "breaching": sorted(budget_breaching),
+                "exceeded_total": budget_exceeded_total,
+            },
+        },
         "suspects": consensus,
     }
 
@@ -223,6 +262,14 @@ class FleetAggregator:
         if burn is not None:
             _burn_gauge.set(burn["rate"])
         _reach_gauge.set(doc["reachable"])
+        perf_doc = doc.get("perf") or {}
+        stages = perf_doc.get("worst_stage_p99") or {}
+        if stages:
+            _worst_p99_gauge.set(
+                max(s["p99"] for s in stages.values()))
+        _budget_breach_gauge.set(
+            len((perf_doc.get("dispatch_budget") or {})
+                .get("breaching") or []))
         self.last = doc
         return doc
 
@@ -243,6 +290,12 @@ def render_fleet(doc: dict) -> str:
         lines.append(
             f"worst burn: {burn['rate']}x ({burn.get('node')} "
             f"{burn.get('objective')}/{burn.get('window')})")
+    perf_doc = doc.get("perf") or {}
+    breaching = (perf_doc.get("dispatch_budget") or {}).get(
+        "breaching") or []
+    if breaching:
+        lines.append(
+            f"dispatch budget BREACH: {', '.join(breaching)}")
     lines.append(f"{'node':20s} {'head':>6s} {'lag':>4s} "
                  f"{'run':>3s} {'findings'}")
     for name in sorted(doc.get("nodes") or {}):

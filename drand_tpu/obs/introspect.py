@@ -12,7 +12,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from drand_tpu.obs import flight, kernels, trace
+from drand_tpu.obs import flight, kernels, perf, trace
 
 
 def _chain_status(beacon, now: float) -> Optional[dict]:
@@ -62,12 +62,23 @@ def _suspects(beacon, now: float) -> list:
 def _dkg_status(dkg) -> dict:
     if dkg is None:
         return {"state": "idle"}
+    # per-phase wall-time accounting rides along in every non-idle
+    # state: after `done` it is the record of where the run's time went
+    phases = getattr(dkg, "phase_seconds", None) or {}
     if getattr(dkg, "_done", False):
-        return {"state": "done"}
-    return {
-        "state": "in_progress",
-        "dealt": bool(getattr(dkg, "_sent_deals", False)),
-    }
+        out = {"state": "done"}
+    else:
+        out = {
+            "state": "in_progress",
+            "dealt": bool(getattr(dkg, "_sent_deals", False)),
+        }
+    if phases:
+        out["phases"] = {
+            name: {k: (round(v, 6) if isinstance(v, float) else v)
+                   for k, v in st.items()}
+            for name, st in sorted(phases.items())
+        }
+    return out
 
 
 def daemon_status(d) -> dict:
@@ -91,6 +102,7 @@ def daemon_status(d) -> dict:
         "suspects": _suspects(beacon, now),
         "serve": (gateway.stats() if gateway is not None else None),
         "kernels": kernels.counters(),
+        "perf": perf.snapshot(now),
         "trace": {
             "enabled": trace.TRACER.enabled,
             "traces": trace.TRACER.trace_count(),

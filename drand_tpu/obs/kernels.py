@@ -28,7 +28,7 @@ import threading
 import time
 from typing import Dict
 
-from drand_tpu.obs import flight, trace
+from drand_tpu.obs import flight, perf, trace
 from drand_tpu.utils import metrics
 
 _hists: Dict[str, object] = {}
@@ -39,6 +39,13 @@ _hists: Dict[str, object] = {}
 # and GET /debug/profile use
 _stats_lock = threading.Lock()
 _stats: Dict[str, Dict[str, float]] = {}
+
+# per-thread dispatch count: a kernel dispatch runs synchronously on the
+# thread that issued it, so diffing this around a call attributes
+# dispatches to THAT call even when several handlers (or offload worker
+# threads) dispatch concurrently in one process — the process-global
+# `dispatch_total()` cannot make that distinction
+_tls = threading.local()
 
 
 def _hist(op: str):
@@ -63,6 +70,10 @@ def _note_dispatch(op: str, dt: float) -> None:
         st["dispatches"] += 1
         st["seconds_total"] += dt
         st["max_seconds"] = max(st["max_seconds"], dt)
+    _tls.dispatches = getattr(_tls, "dispatches", 0) + 1
+    # feed the performance observatory directly (not via the span sink)
+    # so kernel baselines and recompile detection survive tracing off
+    perf.observe_kernel(op, dt)
 
 
 def counters() -> Dict[str, dict]:
@@ -79,6 +90,20 @@ def counters() -> Dict[str, dict]:
             }
             for op, st in sorted(_stats.items())
         }
+
+
+def dispatch_total() -> int:
+    """Total device dispatches across all ops since the last reset."""
+    with _stats_lock:
+        return int(sum(st["dispatches"] for st in _stats.values()))
+
+
+def thread_dispatches() -> int:
+    """Dispatches issued by the CALLING thread, monotonic for the
+    thread's lifetime — the per-round budget accounting diffs this
+    around the finalize so concurrent handlers can't inflate each
+    other's counts.  Unaffected by `reset_counters` (deltas only)."""
+    return int(getattr(_tls, "dispatches", 0))
 
 
 def reset_counters() -> None:

@@ -1,0 +1,637 @@
+"""Performance observatory: runtime baselines + dispatch-budget sentinel.
+
+The repo's perf story so far lives in hand-committed bench artifacts and
+test-only assertions; nothing *running* notices when the hot path gets
+slower.  This module turns the existing kernel spans and round stages
+into continuously tracked, regression-detecting telemetry:
+
+* `StreamingQuantiles` — fixed-memory streaming p50/p95/p99 (one P²
+  marker set per quantile, 15 floats total) so a node can keep latency
+  baselines for every stage and kernel forever without unbounded
+  buffers.
+* `PerfObservatory` — per-stage and per-kernel latency registries fed
+  from the span sink (`beacon.*`, `dkg.*`, `gateway.*`) and from
+  `obs.kernels` dispatch hooks, plus per-round dispatch accounting.
+  The **dispatch-budget sentinel** makes the PR-5 invariant ("honest
+  optimistic round <= 2 device dispatches") a production alarm: an
+  honest round over budget edge-triggers a `perf.dispatch_budget`
+  flight event and bumps `drand_perf_dispatch_budget_exceeded_total`;
+  the alarm clears on the next honest round back within budget.  A
+  kernel dispatch far above its own steady-state p50 *after* warmup is
+  counted as a suspected jit recompile; several inside one window is a
+  recompile storm.
+* Bench lineage + diff: `lineage()` stamps artifacts with provenance
+  (git rev, backend/device, env knobs, degraded flags),
+  `classify_failure()` keeps the bench retry path honest about
+  infra-vs-code degradation, and `extract_stages()`/`diff_stages()`
+  power `cli bench diff` — stage-by-stage comparison with tolerance,
+  where dispatch-count regressions fail regardless of tolerance
+  (they are backend-independent).
+
+Everything here is stdlib-only so the protocol import path stays
+feather-weight; the snapshot is served at `GET /v1/perf`, folded into
+`/v1/status`, aggregated by `obs.fleet` and diagnosed by `cli doctor`.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import platform
+import subprocess
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from drand_tpu.obs import flight
+from drand_tpu.utils import metrics
+
+PERF_SCHEMA = "drand-tpu.perf.v1"
+LINEAGE_SCHEMA = "drand-tpu.lineage.v1"
+
+#: honest optimistic round budget: one fused partial-admit-free finalize
+#: dispatch + one sign dispatch (PR 5's invariant)
+DISPATCH_BUDGET = 2
+
+_QUANTILES = (0.5, 0.95, 0.99)
+
+
+# -- streaming quantiles (P^2 algorithm, Jain & Chlamtac 1985) ------------
+
+
+class _P2:
+    """Single-quantile P² estimator: five markers, O(1) per observation.
+
+    Exact until five observations; afterwards the middle marker tracks
+    the target quantile by piecewise-parabolic adjustment."""
+
+    __slots__ = ("p", "q", "n", "npos", "dn", "count")
+
+    def __init__(self, p: float):
+        self.p = p
+        self.q: List[float] = []            # marker heights
+        self.n = [0, 1, 2, 3, 4]            # marker positions (0-based)
+        self.npos = [0.0, 2 * p, 4 * p, 2 + 2 * p, 4.0]
+        self.dn = [0.0, p / 2, p, (1 + p) / 2, 1.0]
+        self.count = 0
+
+    def observe(self, x: float) -> None:
+        self.count += 1
+        if len(self.q) < 5:
+            bisect.insort(self.q, x)
+            return
+        q, n, npos = self.q, self.n, self.npos
+        if x < q[0]:
+            q[0] = x
+            k = 0
+        elif x >= q[4]:
+            q[4] = x
+            k = 3
+        else:
+            k = 0
+            for i in range(1, 4):
+                if x >= q[i]:
+                    k = i
+        for i in range(k + 1, 5):
+            n[i] += 1
+        for i in range(5):
+            npos[i] += self.dn[i]
+        for i in (1, 2, 3):
+            d = npos[i] - n[i]
+            if ((d >= 1 and n[i + 1] - n[i] > 1)
+                    or (d <= -1 and n[i - 1] - n[i] < -1)):
+                step = 1 if d > 0 else -1
+                qn = self._parabolic(i, step)
+                if not (q[i - 1] < qn < q[i + 1]):
+                    qn = self._linear(i, step)
+                q[i] = qn
+                n[i] += step
+
+    def _parabolic(self, i: int, d: int) -> float:
+        q, n = self.q, self.n
+        return q[i] + d / (n[i + 1] - n[i - 1]) * (
+            (n[i] - n[i - 1] + d) * (q[i + 1] - q[i]) / (n[i + 1] - n[i])
+            + (n[i + 1] - n[i] - d) * (q[i] - q[i - 1]) / (n[i] - n[i - 1])
+        )
+
+    def _linear(self, i: int, d: int) -> float:
+        q, n = self.q, self.n
+        return q[i] + d * (q[i + d] - q[i]) / (n[i + d] - n[i])
+
+    def value(self) -> Optional[float]:
+        if not self.q:
+            return None
+        if self.count < 5:
+            # exact small-sample quantile (nearest-rank interpolation)
+            s = self.q
+            idx = self.p * (len(s) - 1)
+            lo = int(idx)
+            hi = min(lo + 1, len(s) - 1)
+            return s[lo] + (s[hi] - s[lo]) * (idx - lo)
+        return self.q[2]
+
+    def marker_count(self) -> int:
+        return len(self.q) + len(self.n) + len(self.npos)
+
+
+class StreamingQuantiles:
+    """p50/p95/p99 + count/min/max/mean over a stream, fixed memory."""
+
+    __slots__ = ("_est", "count", "vmin", "vmax", "total", "last")
+
+    def __init__(self):
+        self._est = {p: _P2(p) for p in _QUANTILES}
+        self.count = 0
+        self.vmin: Optional[float] = None
+        self.vmax: Optional[float] = None
+        self.total = 0.0
+        self.last: Optional[float] = None
+
+    def observe(self, x: float) -> None:
+        x = float(x)
+        self.count += 1
+        self.total += x
+        self.last = x
+        self.vmin = x if self.vmin is None else min(self.vmin, x)
+        self.vmax = x if self.vmax is None else max(self.vmax, x)
+        for est in self._est.values():
+            est.observe(x)
+
+    def quantile(self, p: float) -> Optional[float]:
+        est = self._est.get(p)
+        return est.value() if est is not None else None
+
+    def marker_count(self) -> int:
+        """Total floats held by the quantile markers — pinned by a test
+        so the estimator provably stays fixed-memory."""
+        return sum(est.marker_count() for est in self._est.values())
+
+    def snapshot(self) -> dict:
+        if self.count == 0:
+            return {"count": 0}
+        r = lambda v: None if v is None else round(v, 6)  # noqa: E731
+        return {
+            "count": self.count,
+            "p50": r(self.quantile(0.5)),
+            "p95": r(self.quantile(0.95)),
+            "p99": r(self.quantile(0.99)),
+            "min": r(self.vmin),
+            "max": r(self.vmax),
+            "mean": r(self.total / self.count),
+            "last": r(self.last),
+        }
+
+
+# -- the observatory ------------------------------------------------------
+
+
+class PerfObservatory:
+    """Per-stage/per-kernel latency baselines + dispatch-budget sentinel.
+
+    Edge-trigger semantics mirror `obs.slo`: the flight-recorder page
+    fires once on the False->True transition of each alarm and once
+    again on recovery; the `*_total` counters count every offending
+    event.  All entry points take an optional timestamp so tests drive
+    the sentinel on a FakeClock."""
+
+    def __init__(self, *, budget: int = DISPATCH_BUDGET,
+                 now_fn: Callable[[], float] = time.time,
+                 recorder: Optional[flight.FlightRecorder] = None,
+                 warmup_dispatches: int = 3,
+                 recompile_factor: float = 20.0,
+                 recompile_min_seconds: float = 0.05,
+                 storm_threshold: int = 3,
+                 storm_window: float = 60.0):
+        self.budget = budget
+        self.now_fn = now_fn
+        self.recorder = recorder  # None -> the process flight recorder
+        self.warmup_dispatches = warmup_dispatches
+        self.recompile_factor = recompile_factor
+        self.recompile_min_seconds = recompile_min_seconds
+        self.storm_threshold = storm_threshold
+        self.storm_window = storm_window
+        self._lock = threading.Lock()
+        self._stages: Dict[str, StreamingQuantiles] = {}
+        self._kernels: Dict[str, StreamingQuantiles] = {}
+        self._breaching: Dict[str, bool] = {}
+        self._recompile_ts: deque = deque(maxlen=64)
+        self._rounds = {
+            "observed": 0, "honest": 0, "fallback": 0,
+            "last_round": None, "last_dispatches": None,
+            "exceeded_total": 0, "episodes": 0,
+        }
+        self._recompiles_suspected = 0
+        self._exceeded_counter = metrics.counter(
+            "drand_perf_dispatch_budget_exceeded_total",
+            "Honest rounds that exceeded their device-dispatch budget",
+        )
+        self._episodes_counter = metrics.counter(
+            "drand_perf_dispatch_budget_episodes_total",
+            "Edge-triggered dispatch-budget breach episodes",
+        )
+        self._recompile_counter = metrics.counter(
+            "drand_perf_recompiles_suspected_total",
+            "Kernel dispatches far above steady-state after warmup "
+            "(suspected jit recompiles)",
+        )
+        self._dispatch_gauge = metrics.gauge(
+            "drand_perf_round_dispatches",
+            "Device dispatches consumed by the last observed round",
+        )
+
+    # -- feeds -----------------------------------------------------------
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            est = self._stages.get(stage)
+            if est is None:
+                est = self._stages[stage] = StreamingQuantiles()
+            est.observe(seconds)
+            p99 = est.quantile(0.99)
+        if p99 is not None:
+            metrics.gauge(
+                "drand_perf_stage_p99_seconds",
+                "Streaming p99 latency per pipeline stage",
+                labels={"stage": stage},
+            ).set(p99)
+
+    def observe_kernel(self, op: str, seconds: float,
+                       now: Optional[float] = None) -> None:
+        now = self.now_fn() if now is None else now
+        suspect = False
+        with self._lock:
+            est = self._kernels.get(op)
+            if est is None:
+                est = self._kernels[op] = StreamingQuantiles()
+            # recompile check against the *previous* steady state, so
+            # the offending sample can't drag its own baseline up first
+            if est.count >= self.warmup_dispatches:
+                p50 = est.quantile(0.5)
+                if (p50 is not None and p50 > 0.0
+                        and seconds >= max(self.recompile_factor * p50,
+                                           self.recompile_min_seconds)):
+                    suspect = True
+            est.observe(seconds)
+            if suspect:
+                self._recompiles_suspected += 1
+                self._recompile_ts.append(now)
+            storm = self._storm_active(now)
+        if suspect:
+            self._recompile_counter.inc()
+        self._edge("recompile_storm", storm, kind="perf.recompile_storm",
+                   op=op, now=now,
+                   suspected_total=self._recompiles_suspected)
+
+    def note_round(self, round: int, dispatches: int, *,
+                   fallback: bool = False,
+                   now: Optional[float] = None) -> None:
+        """Per-round dispatch accounting.  `fallback` marks rounds that
+        are exempt from the budget (blame-fallback retries legitimately
+        re-dispatch; eager mode has no <=2 contract) — they neither
+        trip nor clear the alarm."""
+        now = self.now_fn() if now is None else now
+        exceeded = False
+        with self._lock:
+            self._rounds["observed"] += 1
+            self._rounds["last_round"] = round
+            self._rounds["last_dispatches"] = dispatches
+            if fallback:
+                self._rounds["fallback"] += 1
+            else:
+                self._rounds["honest"] += 1
+                exceeded = dispatches > self.budget
+                if exceeded:
+                    self._rounds["exceeded_total"] += 1
+        self._dispatch_gauge.set(dispatches)
+        if fallback:
+            return
+        if exceeded:
+            self._exceeded_counter.inc()
+        fired = self._edge(
+            "dispatch_budget", exceeded, kind="perf.dispatch_budget",
+            now=now, round=round, dispatches=dispatches,
+            budget=self.budget,
+        )
+        if fired and exceeded:
+            with self._lock:
+                self._rounds["episodes"] += 1
+            self._episodes_counter.inc()
+
+    # -- alarms ----------------------------------------------------------
+
+    def _edge(self, alarm: str, active: bool, *, kind: str,
+              now: float, **fields) -> bool:
+        """Record a flight event only on alarm transitions; returns True
+        when this call was a transition."""
+        with self._lock:
+            was = self._breaching.get(alarm, False)
+            if active == was:
+                return False
+            self._breaching[alarm] = active
+        rec = self.recorder if self.recorder is not None else flight.RECORDER
+        rec.record(kind, status=("breach" if active else "clear"),
+                   time=now, **fields)
+        return True
+
+    def _storm_active(self, now: float) -> bool:
+        cutoff = now - self.storm_window
+        while self._recompile_ts and self._recompile_ts[0] < cutoff:
+            self._recompile_ts.popleft()
+        return len(self._recompile_ts) >= self.storm_threshold
+
+    def breaching(self, alarm: str) -> bool:
+        with self._lock:
+            return self._breaching.get(alarm, False)
+
+    # -- views -----------------------------------------------------------
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        now = self.now_fn() if now is None else now
+        with self._lock:
+            storm = self._storm_active(now)
+            recent = len(self._recompile_ts)
+            doc = {
+                "schema": PERF_SCHEMA,
+                "time": now,
+                "stages": {name: est.snapshot()
+                           for name, est in sorted(self._stages.items())},
+                "kernels": {op: est.snapshot()
+                            for op, est in sorted(self._kernels.items())},
+                "rounds": dict(self._rounds,
+                               budget=self.budget,
+                               breaching=self._breaching.get(
+                                   "dispatch_budget", False)),
+                "recompiles": {
+                    "suspected_total": self._recompiles_suspected,
+                    "recent": recent,
+                    "storm": storm,
+                    "window_seconds": self.storm_window,
+                    "warmup_dispatches": self.warmup_dispatches,
+                },
+            }
+        return doc
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stages.clear()
+            self._kernels.clear()
+            self._breaching.clear()
+            self._recompile_ts.clear()
+            self._recompiles_suspected = 0
+            self._rounds = {
+                "observed": 0, "honest": 0, "fallback": 0,
+                "last_round": None, "last_dispatches": None,
+                "exceeded_total": 0, "episodes": 0,
+            }
+
+
+#: process-wide observatory (handler, gateway, kernels and the span sink
+#: all feed it; /v1/perf serves it)
+OBSERVATORY = PerfObservatory()
+
+observe_stage = OBSERVATORY.observe_stage
+observe_kernel = OBSERVATORY.observe_kernel
+note_round = OBSERVATORY.note_round
+snapshot = OBSERVATORY.snapshot
+reset = OBSERVATORY.reset
+
+#: span-name prefixes routed into the stage registry by the span sink
+_STAGE_PREFIXES = ("beacon.", "dkg.", "gateway.")
+
+
+def span_sink(span_dict: dict) -> None:
+    """Tracer sink: finished pipeline-stage spans become stage samples.
+    Kernel spans are skipped — `obs.kernels` feeds the kernel registry
+    directly (and still counts with tracing off)."""
+    name = span_dict.get("name") or ""
+    duration = span_dict.get("duration")
+    if duration is None or name.startswith("kernel."):
+        return
+    if name.startswith(_STAGE_PREFIXES):
+        OBSERVATORY.observe_stage(name, duration)
+
+
+# -- bench lineage --------------------------------------------------------
+
+_ENV_KEYS = ("JAX_PLATFORMS", "XLA_FLAGS")
+_ENV_PREFIXES = ("DRAND_TPU_", "BENCH_", "LOADGEN_")
+
+
+def git_revision() -> Optional[str]:
+    """Short git rev of the working tree, None outside a checkout."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=5,
+        )
+        rev = out.stdout.strip()
+        return rev if out.returncode == 0 and rev else None
+    except Exception:
+        return None
+
+
+def lineage(*, backend: Optional[str] = None,
+            device: Optional[str] = None,
+            degraded: bool = False,
+            degraded_reason: Optional[str] = None,
+            extra: Optional[dict] = None) -> dict:
+    """Provenance block stamped into every bench/loadgen artifact, so a
+    committed number can always answer "measured where, on what, with
+    which knobs, and did anything fall back"."""
+    if degraded_reason not in (None, "infra", "code"):
+        raise ValueError(
+            f"degraded_reason must be infra|code|None, got {degraded_reason!r}"
+        )
+    env = {k: v for k, v in sorted(os.environ.items())
+           if k in _ENV_KEYS or k.startswith(_ENV_PREFIXES)}
+    doc = {
+        "schema": LINEAGE_SCHEMA,
+        "git_rev": git_revision(),
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+        "backend": backend,
+        "device": device,
+        "degraded": bool(degraded),
+        "degraded_reason": degraded_reason,
+        "env": env,
+    }
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+_INFRA_MARKERS = (
+    "remote compile", "compile cache", "connection", "unavailable",
+    "deadline", "timed out", "timeout", "socket", "dns",
+    "resource exhausted", "out of memory", "sigsegv", "sigill",
+    "sigbus", "signal", "bus error", "failed to initialize",
+    "backend", "rpc", "tunnel", "preempt",
+)
+
+
+def classify_failure(text: str) -> str:
+    """infra|code: is a bench failure the environment's fault or ours?
+    The ROADMAP carry-over: BENCH_r05 died on remote-compile infra and
+    the artifact must never blur that into a code regression."""
+    low = (text or "").lower()
+    return "infra" if any(m in low for m in _INFRA_MARKERS) else "code"
+
+
+# -- bench diff (artifact comparison) ------------------------------------
+
+#: kinds: latency (lower better, tolerance applies), throughput (higher
+#: better, tolerance applies), dispatch (lower better, ZERO tolerance —
+#: dispatch counts are backend-independent)
+_LOWER, _HIGHER, _DISPATCH = "latency", "throughput", "dispatch"
+
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def _put(out: dict, name: str, value, kind: str, unit: str = "") -> None:
+    num = _num(value)
+    if num is not None:
+        out[name] = {"value": num, "kind": kind, "unit": unit}
+
+
+def _pct_stages(out: dict, prefix: str, doc, kind: str = _LOWER) -> None:
+    if not isinstance(doc, dict):
+        return
+    for q in ("p50", "p95", "p99"):
+        _put(out, f"{prefix}.{q}", doc.get(q), kind, "s")
+
+
+def extract_stages(doc: dict) -> Dict[str, dict]:
+    """Flatten any of the repo's artifact shapes (bench.py line,
+    bench_suite payload, loadgen report) into comparable stage scalars."""
+    out: Dict[str, dict] = {}
+    if not isinstance(doc, dict):
+        return out
+
+    # bench.py single-line artifact
+    if "metric" in doc and "value" in doc:
+        unit = str(doc.get("unit", ""))
+        kind = _HIGHER if ("/s" in unit or "per_sec" in unit) else _LOWER
+        _put(out, str(doc["metric"]), doc.get("value"), kind, unit)
+        detail = doc.get("detail") or {}
+        rf = detail.get("round_finalize") or {}
+        _put(out, "round_finalize.dispatches",
+             rf.get("device_dispatches_per_finalize"), _DISPATCH)
+        _put(out, "round_finalize.finalizes_per_sec",
+             rf.get("finalizes_per_sec"), _HIGHER, "/s")
+        _pct_stages(out, "round_finalize",
+                    rf.get("finalize_seconds_percentiles"))
+        opt = rf.get("optimistic") or {}
+        _put(out, "round_finalize.optimistic.dispatches",
+             opt.get("device_dispatches_per_finalize"), _DISPATCH)
+        _put(out, "round_finalize.optimistic.finalizes_per_sec",
+             opt.get("finalizes_per_sec"), _HIGHER, "/s")
+        _pct_stages(out, "round_finalize.optimistic",
+                    opt.get("finalize_seconds_percentiles"))
+        kq = rf.get("kernel_seconds_percentiles") or {}
+        if isinstance(kq, dict):
+            for op, pcts in kq.items():
+                if isinstance(pcts, dict):
+                    _pct_stages(out, f"kernel.{op}", pcts)
+        pi = detail.get("partial_ingest") or {}
+        for mode in ("eager", "lazy"):
+            _pct_stages(out, f"partial_ingest.{mode}", pi.get(mode))
+
+    # bench_suite payload (rows from bench_suite._emit: config/value/
+    # unit/seconds; "_"-prefixed rows are run markers, not measurements)
+    for row in (doc.get("results") or []):
+        if not isinstance(row, dict) or row.get("degraded") \
+                or "skipped" in row:
+            continue
+        name = str(row.get("config") or row.get("name") or "?")
+        if name.startswith("_"):
+            continue
+        unit = str(row.get("unit", ""))
+        _put(out, f"suite.{name}.per_sec", row.get("value"),
+             _HIGHER, unit)
+        _put(out, f"suite.{name}.seconds", row.get("seconds"),
+             _LOWER, "s")
+
+    # loadgen reports
+    bench = doc.get("benchmark")
+    if bench == "serve-gateway-throughput":
+        _put(out, "gateway.batched_rps", doc.get("batched_rps"),
+             _HIGHER, "/s")
+        _put(out, "gateway.sequential_rps", doc.get("sequential_rps"),
+             _HIGHER, "/s")
+        _put(out, "gateway.speedup", doc.get("speedup"), _HIGHER, "x")
+    elif bench == "serve-mesh-gateway":
+        scaling = doc.get("mesh_scaling") or {}
+        _put(out, "mesh.scaling_x", scaling.get("scaling_x"), _HIGHER, "x")
+        hot = doc.get("hot_round") or {}
+        _put(out, "mesh.hit_rate", hot.get("hit_rate"), _HIGHER, "")
+    return out
+
+
+def diff_stages(old: Dict[str, dict], new: Dict[str, dict],
+                tolerance: float = 0.25) -> List[dict]:
+    """Stage-by-stage comparison.  Returns one row per stage seen in
+    either artifact; `verdict` is ok|regression|improved|new|gone.
+    Dispatch-count stages regress on ANY increase (tolerance ignored)."""
+    rows: List[dict] = []
+    for name in sorted(set(old) | set(new)):
+        o, n = old.get(name), new.get(name)
+        if o is None or n is None:
+            rows.append({"stage": name, "kind": (o or n)["kind"],
+                         "old": o and o["value"], "new": n and n["value"],
+                         "delta_pct": None,
+                         "verdict": "new" if o is None else "gone"})
+            continue
+        ov, nv, kind = o["value"], n["value"], n["kind"]
+        delta = None if ov == 0 else (nv - ov) / abs(ov) * 100.0
+        if kind == _DISPATCH:
+            verdict = ("regression" if nv > ov
+                       else "improved" if nv < ov else "ok")
+        elif kind == _HIGHER:
+            verdict = ("regression" if nv < ov * (1.0 - tolerance)
+                       else "improved" if nv > ov * (1.0 + tolerance)
+                       else "ok")
+        else:
+            verdict = ("regression" if nv > ov * (1.0 + tolerance)
+                       else "improved" if nv < ov * (1.0 - tolerance)
+                       else "ok")
+        rows.append({"stage": name, "kind": kind, "old": ov, "new": nv,
+                     "delta_pct": (None if delta is None
+                                   else round(delta, 1)),
+                     "verdict": verdict})
+    return rows
+
+
+def load_artifact(path: str) -> dict:
+    """Parse a bench/loadgen artifact file.  bench.py output may carry
+    retry-marker lines before the final artifact; keep the LAST line
+    that parses as a recognisable document."""
+    import json
+
+    text = open(path).read()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            return doc
+    except ValueError:
+        pass
+    best = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and (
+                "metric" in doc or "results" in doc or "benchmark" in doc):
+            best = doc
+    if best is None:
+        raise ValueError(f"no parseable bench artifact in {path}")
+    return best

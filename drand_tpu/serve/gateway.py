@@ -45,6 +45,7 @@ from drand_tpu.beacon.chain import Beacon, beacon_message
 from drand_tpu.crypto import refimpl as ref
 from drand_tpu.crypto import tbls
 from drand_tpu.obs import flight as obs_flight
+from drand_tpu.obs import perf as obs_perf
 from drand_tpu.obs import slo as obs_slo
 from drand_tpu.obs import trace as obs_trace
 from drand_tpu.serve.batcher import (
@@ -586,8 +587,12 @@ class VerifyGateway:
                 self.dist_key, msgs, sigs
             )
         finally:
-            self._flush_seconds += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self._flush_seconds += dt
             self._flush_items += len(msgs)
+            # gateway flush latency joins the perf observatory's stage
+            # baselines (same registry the round stages feed)
+            obs_perf.observe_stage("gateway.flush", dt)
 
     def _run_kernel_mesh(self, lane_msgs: List[List[bytes]],
                          lane_sigs: List[List[bytes]]
@@ -598,8 +603,10 @@ class VerifyGateway:
                 self.dist_key, lane_msgs, lane_sigs
             )
         finally:
-            self._flush_seconds += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self._flush_seconds += dt
             self._flush_items += sum(len(l) for l in lane_msgs)
+            obs_perf.observe_stage("gateway.flush_mesh", dt)
 
     async def _flush(self, items: List[BatchItem]) -> None:
         loop = asyncio.get_event_loop()

@@ -548,6 +548,18 @@ def main(argv=None) -> int:
             args.max_wait, args.dispatch_ms, args.per_item_us,
             args.metrics_port,
         ))
+    # provenance: where these numbers came from (git rev, backend, env
+    # knobs) and whether the run is degraded — a mesh suite that missed
+    # its acceptance gates is a `code` degradation of the measured path
+    from drand_tpu.obs import perf
+
+    degraded = bool(report.get("degraded"))
+    report["lineage"] = perf.lineage(
+        backend=args.backend,
+        device=report.get("mesh_backend") or report.get("backend_class"),
+        degraded=degraded,
+        degraded_reason="code" if degraded else None,
+    )
     text = json.dumps(report, indent=2)
     print(text)
     if args.out:

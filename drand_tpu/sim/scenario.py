@@ -121,6 +121,11 @@ class SimReport:
     #: attached observer's verdict (`ChainWatcher.snapshot()`) when the
     #: run was made with watch=True; None otherwise
     watch: Optional[dict] = None
+    #: wall-clock performance envelope of the run (obs.perf snapshot of
+    #: the spans the simulated nodes emitted): per-stage p50/p95/p99 and
+    #: kernel tails.  Deliberately NOT part of `event_log` — wall-clock
+    #: timings vary run to run and would break byte-identical replay.
+    perf: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = asdict(self)
@@ -128,6 +133,8 @@ class SimReport:
         d.pop("event_log")
         if d.get("watch") is None:
             d.pop("watch", None)
+        if d.get("perf") is None:
+            d.pop("perf", None)
         return d
 
     def to_json(self, indent: Optional[int] = 2) -> str:
@@ -153,6 +160,41 @@ def _node_status(node, genesis: int, period: float) -> dict:
 
 
 async def _run(scn: Scenario, seed: int, watch: bool = False) -> SimReport:
+    # a run-local performance observatory fed from the same spans the
+    # global one watches: the report's `perf` envelope covers THIS run
+    # only, without resetting process-global state other tests share
+    from drand_tpu.obs import flight as obs_flight
+    from drand_tpu.obs import perf as obs_perf
+    from drand_tpu.obs import trace as obs_trace
+
+    # a private flight ring: sentinel transitions from the local
+    # observatory must not land in the process recorder (or the log)
+    run_perf = obs_perf.PerfObservatory(
+        recorder=obs_flight.FlightRecorder(capacity=64))
+
+    def _perf_sink(span: dict) -> None:
+        dur = span.get("duration")
+        if dur is None:
+            return
+        name = span.get("name", "")
+        if name.startswith("kernel."):
+            run_perf.observe_kernel(name[len("kernel."):], dur)
+        elif name.startswith(("beacon.", "dkg.", "gateway.")):
+            run_perf.observe_stage(name, dur)
+
+    obs_trace.TRACER.add_sink(_perf_sink)
+    try:
+        report = await _run_world(scn, seed, watch=watch)
+    finally:
+        obs_trace.TRACER.remove_sink(_perf_sink)
+    perf_doc = run_perf.snapshot()
+    if perf_doc.get("stages") or perf_doc.get("kernels"):
+        report.perf = perf_doc
+    return report
+
+
+async def _run_world(scn: Scenario, seed: int,
+                     watch: bool = False) -> SimReport:
     world = SimWorld(
         n=scn.n, threshold=scn.threshold, period=scn.period, seed=seed,
         skews=scn.skews, byzantine=scn.byzantine,

@@ -17,6 +17,28 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 JSONL = REPO / "bench_matrix.jsonl"
 LOG = REPO / "bench_matrix.jsonl.log"
 MESH_LOADGEN = REPO / "loadgen_mesh_gateway.json"
+GATEWAY_LOADGEN = REPO / "loadgen_gateway.json"
+BENCH_BASELINE = REPO / "bench_baseline_cpu.json"
+
+
+def _check_lineage(doc: dict) -> dict:
+    """The lineage block every new artifact must carry
+    (obs.perf.lineage, schema drand-tpu.lineage.v1)."""
+    lin = doc.get("lineage") or (doc.get("detail") or {}).get("lineage")
+    assert lin, "artifact has no lineage block"
+    assert lin["schema"] == "drand-tpu.lineage.v1"
+    assert {"git_rev", "backend", "device", "degraded",
+            "degraded_reason", "env"} <= lin.keys()
+    assert isinstance(lin["degraded"], bool)
+    # the reason vocabulary is closed: infra (environment's fault) or
+    # code (the measured path's fault); honest artifacts say which
+    assert lin["degraded_reason"] in (None, "infra", "code")
+    if lin["degraded"]:
+        assert lin["degraded_reason"] is not None, (
+            "degraded artifact must say WHY (infra|code)")
+    else:
+        assert lin["degraded_reason"] is None
+    return lin
 
 
 @pytest.mark.skipif(not JSONL.exists(), reason="no committed bench matrix")
@@ -81,3 +103,42 @@ def test_mesh_loadgen_artifact_meets_acceptance_gates():
     assert over["shed_queue_full"] + over["shed_deadline"] > 0
     assert over["deadline_blown_successes"] == 0, over
     assert over["served"] > 0  # shed is load-shedding, not an outage
+
+    _check_lineage(doc)
+
+
+@pytest.mark.skipif(not GATEWAY_LOADGEN.exists(),
+                    reason="no committed gateway loadgen artifact")
+def test_gateway_loadgen_artifact_carries_lineage():
+    doc = json.loads(GATEWAY_LOADGEN.read_text())
+    assert doc["benchmark"] == "serve-gateway-throughput"
+    lin = _check_lineage(doc)
+    assert lin["backend"] == doc["backend"]
+    assert doc["speedup"] > 1.0  # batching must actually help
+
+
+@pytest.mark.skipif(not BENCH_BASELINE.exists(),
+                    reason="no committed CPU bench baseline")
+def test_bench_baseline_is_diffable_and_has_lineage():
+    """The committed CI baseline must parse through the same pipeline
+    `cli bench diff` uses and carry the dispatch-count stages the CI
+    gate regresses on (zero tolerance — dispatch counts are
+    backend-independent)."""
+    from drand_tpu.obs import perf
+
+    doc = perf.load_artifact(str(BENCH_BASELINE))
+    _check_lineage(doc)
+    stages = perf.extract_stages(doc)
+    assert "round_finalize.dispatches" in stages, sorted(stages)
+    disp = stages["round_finalize.dispatches"]
+    assert disp["kind"] == "dispatch"
+    # PR-5 invariant, now pinned in the committed baseline itself:
+    # eager finalize <= 2 device dispatches, optimistic strictly fewer
+    # or equal
+    assert disp["value"] <= 2.0, disp
+    opt = stages.get("round_finalize.optimistic.dispatches")
+    assert opt is not None and opt["value"] <= disp["value"]
+    # identical artifacts diff clean: the gate can never false-positive
+    # on an unchanged tree
+    rows = perf.diff_stages(stages, stages)
+    assert all(r["verdict"] == "ok" for r in rows)
