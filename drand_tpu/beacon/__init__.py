@@ -17,6 +17,7 @@ from drand_tpu.beacon.chain import (  # noqa: F401
 from drand_tpu.beacon.store import (  # noqa: F401
     BeaconStore,
     CallbackStore,
+    RollbackDepthExceeded,
     open_store,
 )
 from drand_tpu.beacon.handler import BeaconHandler, BeaconConfig  # noqa: F401

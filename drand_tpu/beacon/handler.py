@@ -25,7 +25,7 @@ from __future__ import annotations
 import asyncio
 import random
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from drand_tpu.beacon.chain import (
     Beacon,
@@ -36,7 +36,11 @@ from drand_tpu.beacon.chain import (
     time_of_round,
 )
 from drand_tpu.beacon.round_cache import RoundManager
-from drand_tpu.beacon.store import BeaconStore, CallbackStore
+from drand_tpu.beacon.store import (
+    BeaconStore,
+    CallbackStore,
+    RollbackDepthExceeded,
+)
 from drand_tpu.crypto import tbls
 # BeaconPacket/ProtocolClient live in net/interface.py (transport
 # interface extraction); re-exported here because this was their
@@ -46,6 +50,7 @@ from drand_tpu.net.interface import (  # noqa: F401
     ProtocolClient,
 )
 from drand_tpu.key import Group, Identity, Share
+from drand_tpu.obs import flight as obs_flight
 from drand_tpu.obs import kernels as obs_kernels
 from drand_tpu.obs import peers as obs_peers
 from drand_tpu.obs import perf as obs_perf
@@ -89,6 +94,57 @@ _head_gauge = metrics.gauge(
     "drand_beacon_head_round", "chain head round of this node"
 )
 
+
+def _reorg_counter(depth: int):
+    return metrics.counter(
+        "drand_chain_reorgs_total",
+        "chain reorgs adopted (highest-round fully-verified chain wins)",
+        labels={"depth": str(depth)},
+    )
+
+
+def _sync_failure_counter(reason: str):
+    return metrics.counter(
+        "drand_sync_failures_total",
+        "per-peer catch-up sync attempts that failed, by reason",
+        labels={"reason": reason},
+    )
+
+
+class ChainLinkBroken(ValueError):
+    """A peer's synced segment does not link onto our chain head —
+    either the peer is corrupt or we are on different fork branches.
+    Carries the first offending round so fork resolution can start
+    from it."""
+
+    def __init__(self, round: int, detail: str = ""):
+        super().__init__(
+            detail or f"chain link broken at round {round}"
+        )
+        self.round = round
+
+
+class ChainSignatureInvalid(ValueError):
+    """A synced segment failed the batched threshold-signature check."""
+
+    def __init__(self, rounds: List[int]):
+        super().__init__(f"invalid signatures at rounds {rounds}")
+        self.rounds = rounds
+
+
+class ForkRejected(RuntimeError):
+    """A competing branch was examined and NOT adopted (lower or equal
+    head, missing anchor, or internally broken) — the local chain is
+    untouched."""
+
+
+class SyncSuperseded(RuntimeError):
+    """The local chain advanced while a sync batch was in flight; the
+    batch no longer extends the real head and was discarded.  Storing
+    it anyway would write beacons UNDER the new head — if a finalize
+    moved the head onto a different branch meanwhile (fork_stall's
+    round 7), that silently plants a broken link in the store."""
+
 #: how many sync'd beacons to verify per device batch
 SYNC_BATCH = 64
 
@@ -103,6 +159,24 @@ GOSSIP_RETRY_DELAY = 0.1
 #: optimistic finalize: bounded blame/evict/retry rounds before the
 #: quorum is declared unrecoverable and the attempt abandoned
 FINALIZE_ATTEMPTS = 8
+
+
+def _sync_failure_reason(exc: BaseException) -> str:
+    """Label value for drand_sync_failures_total."""
+    if isinstance(exc, RollbackDepthExceeded):
+        return "reorg_beyond_cap"
+    if isinstance(exc, ForkRejected):
+        return "fork_not_better"
+    if isinstance(exc, ChainSignatureInvalid):
+        return "bad_signature"
+    if isinstance(exc, ChainLinkBroken):
+        return "chain_link"
+    if isinstance(exc, SyncSuperseded):
+        return "superseded"
+    if isinstance(exc, (ConnectionError, OSError, TimeoutError,
+                        asyncio.TimeoutError)):
+        return "transport"
+    return "other"
 
 
 def _counted(fn, *args):
@@ -131,6 +205,12 @@ class BeaconConfig:
     #: beacons verified per device batch during catch-up; the pipelined
     #: sync prefetches the next batch while this one is on device
     sync_batch: int = SYNC_BATCH
+    #: hard cap on reorg depth: a competing branch whose divergence
+    #: point is more than this many rounds behind our head is refused
+    #: (typed error + flight event, chain untouched).  Deep reorgs on a
+    #: randomness beacon mean consumers already acted on orphaned
+    #: values — that needs an operator, not an automatic rewrite.
+    reorg_depth: int = 64
     #: "optimistic" (default): inbound partials are admitted with cheap
     #: structural checks only and the quorum is verified via ONE
     #: recovered-signature check, falling back to the batched blame pass
@@ -208,6 +288,25 @@ class BeaconHandler:
             threshold=0.5 * cfg.group.period,
             describe="99% of rounds finalize within half the period",
         )
+        #: round -> peer address that SERVED us the beacon (synced or
+        #: reorg-adopted; self-finalized rounds have no entry).  When a
+        #: reorg orphans a round, its *sender* — never the claimed
+        #: signer indices — takes the soft ledger charge.
+        self._beacon_sources: Dict[int, str] = {}
+        #: observers notified on every adopted reorg with a dict of
+        #: deterministic fields (the simulator's event log taps this)
+        self._reorg_callbacks: List[Callable[[dict], None]] = []
+        #: edge triggers: one starvation event per outage, one refusal
+        #: event per (peer, divergence) fork
+        self._sync_starved = False
+        self._refused_forks: set = set()
+        #: lifetime reorg summary surfaced at GET /v1/status
+        self.reorg_stats: dict = {"total": 0, "max_depth": 0,
+                                  "last": None}
+        #: the chain link the ACTIVE round task signed against, so a
+        #: catch-up that moves the head mid-round can tell the task is
+        #: pinned to a stale link and restart it (_refresh_round_task)
+        self._round_link: Optional[Tuple[int, bytes]] = None
         self._running = False
         self._stop_at: Optional[int] = None
         self._loop_task: Optional[asyncio.Task] = None
@@ -261,6 +360,12 @@ class BeaconHandler:
 
     def add_callback(self, cb: Callable[[Beacon], None]) -> None:
         self.store.add_callback(cb)
+
+    def add_reorg_callback(self, cb: Callable[[dict], None]) -> None:
+        """`cb(event)` after every adopted reorg; `event` carries only
+        deterministic fields (node, peer, divergence_round, depth,
+        old_head, new_head)."""
+        self._reorg_callbacks.append(cb)
 
     # -- internals --------------------------------------------------------
 
@@ -337,6 +442,7 @@ class BeaconHandler:
     async def _run_round_traced(self, round: int, head: Beacon,
                                 t_start: float, tid: str) -> None:
         prev_round, prev_sig = head.round, head.signature
+        self._round_link = (prev_round, prev_sig)
         msg = beacon_message(prev_sig, prev_round, round)
         # sign OFF the event loop (reference: the round goroutine,
         # beacon.go:433).  A synchronous sign blocks every ingest task
@@ -422,6 +528,36 @@ class BeaconHandler:
         cur_head = self.store.last()
         if cur_head is not None and cur_head.round >= round:
             return
+        if cur_head is not None and (
+                cur_head.round != prev_round
+                or cur_head.signature != prev_sig):
+            # a sync landed mid-round and moved the head onto a branch
+            # DIFFERENT from the link this quorum signed.  The quorum's
+            # beacon carries a valid threshold signature and its round
+            # is higher than the new head, so highest-round-wins says
+            # the quorum's branch is the chain: roll back to the signed
+            # link and adopt.  Storing it blind instead would write a
+            # broken link into the store (the fork_stall bug's second
+            # half); refusing would wedge us off the branch the rest of
+            # the quorum is extending.
+            try:
+                adopted = self._adopt_reorg(
+                    base_round=prev_round, base_sig=prev_sig,
+                    suffix=[beacon], source="", via="quorum",
+                    put_suffix=False,  # the span below does the put
+                )
+            except RollbackDepthExceeded:
+                adopted = False
+            if not adopted:
+                _rounds_failed.inc()
+                obs_slo.ENGINE.record_bad(obs_slo.ROUND_FINALIZE,
+                                          ts=self.clock.now())
+                self.log.warning(
+                    "abandoning finalized round: head moved to a branch "
+                    "this quorum's link cannot extend",
+                    round=round, head=cur_head.round,
+                )
+                return
         with obs_trace.TRACER.span(
             "beacon.store",
             attrs={"round": round, "node": self.cfg.public.address},
@@ -561,6 +697,34 @@ class BeaconHandler:
         if self._resync_task is None or self._resync_task.done():
             self._resync_task = asyncio.create_task(self.sync())
 
+    def _refresh_round_task(self) -> None:
+        """A catch-up advanced the head while a round was in flight.
+
+        The active round task pinned its chain link to the PRE-sync
+        head, so the majority's partials (linking the fresh head) were
+        screened out and it can never finalize — a healed node would
+        trail the fleet by exactly one round forever, re-syncing round
+        n-1 at every round-n open.  Restart the task against the fresh
+        head: the round manager re-offers the mislinked partials it
+        kept, and the quorum that was already on the wire counts."""
+        if not self._running:
+            return
+        task = self._round_task
+        if task is None or task.done():
+            return
+        head = self.store.last()
+        cur = current_round(self.clock.now(), self.group.period,
+                            self.group.genesis_time)
+        if head is None or head.round >= cur:
+            return  # at/past the scheduled round: nothing to re-run
+        link = self._round_link
+        if link is None or link[0] == head.round:
+            return  # the active round already signs the fresh link
+        self.log.info("restarting round against caught-up head",
+                      round=cur, old_link=link[0], new_link=head.round)
+        task.cancel()
+        self._round_task = asyncio.create_task(self._run_round(cur))
+
     async def _send_packet(self, node: Identity,
                            packet: BeaconPacket) -> None:
         async with self._gossip_sem:
@@ -689,17 +853,43 @@ class BeaconHandler:
         peers = [n for n in (peers or self.group.nodes)
                  if n.address != self.cfg.public.address]
         self._rng.shuffle(peers)
+        attempted = 0
         for peer in peers:
+            attempted += 1
             try:
                 await self._sync_from(peer)
             except Exception as exc:
-                self.log.debug("sync failed", peer=peer.address, err=exc)
+                reason = _sync_failure_reason(exc)
+                _sync_failure_counter(reason).inc()
+                self.log.debug("sync failed", peer=peer.address,
+                               reason=reason, err=exc)
             head = self.store.last()
             now = self.clock.now()
             cur = current_round(now, self.group.period,
                                 self.group.genesis_time)
             if head is not None and head.round >= cur - 1:
+                self._sync_starved = False  # recovered: re-arm the edge
+                self._refresh_round_task()
                 return  # caught up enough to join
+        if attempted and not self._sync_starved:
+            # every peer failed (or served too little) and we are still
+            # behind — catch-up starvation.  Edge-triggered: one flight
+            # event per outage, not one per resync attempt, so `cli
+            # doctor` sees the incident without the ring buffer
+            # drowning in repeats.
+            self._sync_starved = True
+            head = self.store.last()
+            obs_flight.RECORDER.record(
+                "sync_starved",
+                node=self.cfg.public.address,
+                peers_tried=attempted,
+                head_round=head.round if head else None,
+                current_round=current_round(
+                    self.clock.now(), self.group.period,
+                    self.group.genesis_time),
+            )
+            self.log.warning("catch-up starved: every peer failed",
+                             peers_tried=attempted)
 
     async def _sync_from(self, peer: Identity) -> None:
         """Double-buffered catch-up from one peer: while batch k sits on
@@ -721,6 +911,7 @@ class BeaconHandler:
                     break
             return batch
 
+        broken: Optional[ChainLinkBroken] = None
         try:
             batch = await next_batch()
             batch_index = 0
@@ -738,7 +929,9 @@ class BeaconHandler:
                            "node": self.cfg.public.address},
                 ) as sync_span:
                     try:
-                        head = await self._verify_and_store(head, batch)
+                        head = await self._verify_and_store(
+                            head, batch, source=peer.address
+                        )
                     except BaseException:
                         # a broken link / bad signature must not orphan
                         # the in-flight prefetch (or leak its exception)
@@ -754,6 +947,11 @@ class BeaconHandler:
                                        prefetch.done())
                 batch_index += 1
                 batch = await prefetch
+        except ChainLinkBroken as exc:
+            # the peer's chain does not extend ours: this is a fork,
+            # not a plain gap — resolution happens below on a fresh
+            # stream (the finally closes this one first)
+            broken = exc
         finally:
             aclose = getattr(stream, "aclose", None)
             if aclose is not None:
@@ -761,17 +959,17 @@ class BeaconHandler:
                     await aclose()
                 except Exception:
                     pass
+        if broken is not None:
+            await self._resolve_fork(peer, broken)
 
-    async def _verify_and_store(self, head: Beacon,
-                                batch: List[Beacon]) -> Beacon:
+    async def _verify_and_store(self, head: Beacon, batch: List[Beacon],
+                                source: str = "") -> Beacon:
         # chain-link checks (cheap, host side)
         prev = head
         for b in batch:
             if b.prev_round != prev.round or b.prev_sig != prev.signature \
                     or b.round <= prev.round:
-                raise ValueError(
-                    f"chain link broken at round {b.round}"
-                )
+                raise ChainLinkBroken(b.round)
             prev = b
         msgs = [
             beacon_message(b.prev_sig, b.prev_round, b.round)
@@ -785,9 +983,241 @@ class BeaconHandler:
         )
         if not all(ok):
             bad = [batch[i].round for i, v in enumerate(ok) if not v]
-            raise ValueError(f"invalid signatures at rounds {bad}")
+            raise ChainSignatureInvalid(bad)
+        # the pairing check yielded the event loop: a concurrent
+        # finalize may have moved the head off the snapshot this batch
+        # links onto (possibly onto ANOTHER BRANCH — fork_stall's B
+        # finalizes 7-on-5 while its resync still holds a verified
+        # [6]).  Storing the batch then would plant beacons under the
+        # new head and break linkage; discard it and let the next sync
+        # restart from the real head.
+        cur = self.store.last()
+        if cur is not None and (cur.round != head.round
+                                or cur.signature != head.signature):
+            raise SyncSuperseded(
+                f"head moved {head.round}->{cur.round} while a sync "
+                f"batch ending at {batch[-1].round} was on device"
+            )
         _sync_verified.inc(len(batch))
         for b in batch:
             self.store.put(b)
+            if source:
+                self._beacon_sources[b.round] = source
+        self._prune_sources(batch[-1].round)
         _head_gauge.set(batch[-1].round)
         return batch[-1]
+
+    def _prune_sources(self, head_round: int) -> None:
+        # sender bookkeeping only matters within reorg reach of the head
+        cap = max(1, self.cfg.reorg_depth)
+        if len(self._beacon_sources) <= 8 * cap:
+            return
+        horizon = head_round - 4 * cap
+        for r in [r for r in self._beacon_sources if r < horizon]:
+            del self._beacon_sources[r]
+
+    # -- fork resolution ---------------------------------------------------
+
+    async def _resolve_fork(self, peer: Identity,
+                            broken: ChainLinkBroken) -> None:
+        """Highest-round fully-verified chain wins — the reorg policy.
+
+        Called when `peer`'s chain breaks linkage against ours: both
+        branches may carry valid threshold signatures (a partition
+        fork — fork_stall's exact shape).  Pull the peer's branch from
+        inside the reorg window, find the divergence point against our
+        store, verify the competitor suffix end-to-end through the
+        batched/mesh pairing path, and adopt it iff its verified head
+        is STRICTLY higher than ours.  Anything else raises with the
+        local chain untouched: :class:`ForkRejected` (lower/equal head,
+        broken branch, nothing divergent), :class:`RollbackDepthExceeded`
+        (divergence beyond the cap), :class:`ChainSignatureInvalid`
+        (forged branch — the sender is charged `record_invalid`).
+        """
+        cap = max(1, self.cfg.reorg_depth)
+        head = self.store.last()
+        assert head is not None
+        lo = max(1, head.round - cap)
+        # bound the pull: enough shared prefix to locate the divergence
+        # plus enough suffix to beat our head by whole batches — a peer
+        # further ahead than this is finished off by the next regular
+        # sync, which continues from the adopted head
+        max_pull = cap + 2 * max(1, self.cfg.sync_batch)
+        branch: List[Beacon] = []
+        stream = self.client.sync_chain(peer, lo)
+        try:
+            async for b in stream:
+                branch.append(b)
+                if len(branch) >= max_pull:
+                    break
+        finally:
+            aclose = getattr(stream, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:
+                    pass
+        # drop the shared prefix (beacons byte-identical to ours); what
+        # remains is the competitor suffix
+        suffix: List[Beacon] = []
+        for b in branch:
+            if not suffix:
+                ours = self.store.get(b.round)
+                if ours is not None and ours == b:
+                    continue
+            suffix.append(b)
+        if not suffix:
+            raise ForkRejected(
+                f"{peer.address} served nothing divergent from round "
+                f"{lo} on (link broke at {broken.round} but the "
+                "re-pull matched our chain)"
+            )
+        first = suffix[0]
+        prev = first
+        for b in suffix[1:]:
+            if b.prev_round != prev.round \
+                    or b.prev_sig != prev.signature \
+                    or b.round <= prev.round:
+                raise ForkRejected(
+                    f"competitor branch from {peer.address} is itself "
+                    f"broken at round {b.round}"
+                )
+            prev = b
+        new_head = suffix[-1]
+        # the policy gate: a competitor that cannot strictly beat our
+        # head is noise, not a reorg (equal heads keep paging as a
+        # fork at the watchdog until one branch outgrows the other)
+        if new_head.round <= head.round:
+            raise ForkRejected(
+                f"competitor head {new_head.round} from {peer.address} "
+                f"does not beat ours ({head.round})"
+            )
+        # the divergence base must be a beacon we hold byte-identically;
+        # a deeper divergence than the pulled window is beyond the cap
+        # by construction
+        anchor = self.store.get(first.prev_round)
+        if anchor is None or anchor.signature != first.prev_sig:
+            depth = max(head.round - first.prev_round, cap + 1)
+            self._note_reorg_refused(peer.address, first.prev_round,
+                                     depth, cap)
+            raise RollbackDepthExceeded(first.prev_round, depth, cap)
+        # end-to-end threshold verification of the competitor suffix —
+        # same batched/mesh pairing path as regular catch-up, off-loop
+        msgs = [beacon_message(b.prev_sig, b.prev_round, b.round)
+                for b in suffix]
+        sigs = [b.signature for b in suffix]
+        ok = await self._offload(
+            self.scheme.verify_chain_batch, self.dist_key, msgs, sigs
+        )
+        if not all(ok):
+            bad = [suffix[i].round for i, v in enumerate(ok) if not v]
+            # a forged competitor is proof of misbehavior by the SENDER
+            # (unlike an orphaned-but-valid branch, which is not)
+            self.peer_ledger.record_invalid(peer.address,
+                                            self.clock.now())
+            raise ChainSignatureInvalid(bad)
+        _sync_verified.inc(len(suffix))
+        if not self._adopt_reorg(
+            base_round=first.prev_round, base_sig=first.prev_sig,
+            suffix=suffix, source=peer.address, via="sync",
+        ):
+            raise ForkRejected(
+                f"divergence base {first.prev_round} moved while "
+                "resolving the fork — retrying on the next sync"
+            )
+
+    def _adopt_reorg(self, base_round: int, base_sig: bytes,
+                     suffix: List[Beacon], source: str, via: str,
+                     put_suffix: bool = True) -> bool:
+        """Atomically switch to a verified competitor branch.
+
+        Rolls the store back to `(base_round, base_sig)` (bounded by
+        `cfg.reorg_depth` — raises :class:`RollbackDepthExceeded`, store
+        untouched, when the cap refuses), re-applies `suffix`, charges
+        the orphaned beacons' *senders* (`record_orphaned`, soft —
+        never the claimed signer indices), invalidates the round
+        manager + scheme round caches, and emits the `chain.reorg`
+        flight event / `drand_chain_reorgs_total{depth}` metric /
+        registered reorg callbacks.  Returns False (nothing changed)
+        when the anchor no longer matches.
+        """
+        anchor = self.store.get(base_round)
+        if anchor is None or anchor.signature != base_sig:
+            return False
+        cap = max(1, self.cfg.reorg_depth)
+        old_head = self.store.last()
+        try:
+            dropped = self.store.rollback_to(base_round, max_depth=cap)
+        except RollbackDepthExceeded as exc:
+            self._note_reorg_refused(source or via, base_round,
+                                     exc.depth, exc.cap)
+            raise
+        now = self.clock.now()
+        orphan_senders: Dict[str, int] = {}
+        for b in dropped:
+            src = self._beacon_sources.pop(b.round, "")
+            if src:
+                orphan_senders[src] = orphan_senders.get(src, 0) + 1
+        if put_suffix:
+            for b in suffix:
+                self.store.put(b)
+                if source:
+                    self._beacon_sources[b.round] = source
+        for src in sorted(orphan_senders):
+            self.peer_ledger.record_orphaned(src, now,
+                                             rounds=orphan_senders[src])
+        depth = len(dropped)
+        new_head = suffix[-1].round if suffix else base_round
+        _head_gauge.set(new_head)
+        _reorg_counter(depth).inc()
+        # the active round collected partials against an orphaned link:
+        # poison — drop it so the next tick signs the adopted head.
+        # (The quorum path calls this from INSIDE the round task, which
+        # is about to store its own beacon — never cancel that.)
+        if via != "quorum" and self._round_task is not None \
+                and not self._round_task.done():
+            self._round_task.cancel()
+        self.manager.invalidate()
+        invalidate = getattr(self.scheme, "invalidate_round_caches",
+                             None)
+        if invalidate is not None:
+            invalidate()
+        ev = {
+            "node": self.cfg.public.address,
+            "peer": source,
+            "via": via,
+            "divergence_round": base_round,
+            "depth": depth,
+            "old_head": old_head.round if old_head else base_round,
+            "new_head": new_head,
+        }
+        self.reorg_stats["total"] += 1
+        self.reorg_stats["max_depth"] = max(
+            self.reorg_stats["max_depth"], depth)
+        self.reorg_stats["last"] = dict(ev, ts=now)
+        obs_flight.RECORDER.record("chain.reorg", **ev)
+        for cb in list(self._reorg_callbacks):
+            try:
+                cb(dict(ev))
+            except Exception:  # observers must never break the chain
+                pass
+        self.log.warning("chain reorg", **ev)
+        return True
+
+    def _note_reorg_refused(self, peer: str, base: int, depth: int,
+                            cap: int) -> None:
+        """Edge-triggered beyond-cap refusal: one flight event per
+        (peer, divergence) fork, however many syncs re-encounter it."""
+        key = (peer, base)
+        if key in self._refused_forks:
+            return
+        self._refused_forks.add(key)
+        obs_flight.RECORDER.record(
+            "chain.reorg_refused",
+            node=self.cfg.public.address, peer=peer,
+            divergence_round=base, depth=depth, cap=cap,
+        )
+        self.log.error(
+            "reorg refused: competitor diverges beyond the depth cap",
+            peer=peer, divergence_round=base, depth=depth, cap=cap,
+        )

@@ -15,6 +15,7 @@ from typing import List, Optional
 
 from drand_tpu import native
 from drand_tpu.beacon.chain import Beacon
+from drand_tpu.beacon.store import RollbackDepthExceeded
 
 _CAP = 4096  # signature buffer capacity (sigs are 96B; headroom is free)
 
@@ -57,6 +58,10 @@ def _load():
         lib.dtcs_seek.argtypes = lookup
         lib.dtcs_first.argtypes = nolookup
         lib.dtcs_last.argtypes = nolookup
+        lib.dtcs_rollback.restype = ctypes.c_int64
+        lib.dtcs_rollback.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64,
+        ]
         _lib = lib
     return _lib
 
@@ -134,6 +139,24 @@ class NativeBeaconStore:
             out.append(b)
             rnd = b.round + 1
         return out
+
+    def rollback_to(self, round: int,
+                    max_depth: Optional[int] = None) -> List[Beacon]:
+        """Drop every beacon with round > `round` (chain reorg).
+
+        Durable via a truncate record appended to the log (see
+        chainstore.cc) — a crash mid-rollback replays to either the
+        pre- or post-rollback chain, never a mix.  Raises
+        :class:`RollbackDepthExceeded` (store untouched) beyond the cap."""
+        dropped = self.range_from(round + 1)
+        cap = -1 if max_depth is None else max_depth
+        rc = int(self._lib.dtcs_rollback(
+            self._h, ctypes.c_uint64(round), ctypes.c_int64(cap)))
+        if rc == -3:
+            raise RollbackDepthExceeded(round, len(dropped), cap)
+        if rc < 0:
+            raise IOError(f"native store rollback failed (rc={rc})")
+        return dropped
 
     def close(self) -> None:
         if self._h:

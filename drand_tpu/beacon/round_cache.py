@@ -29,6 +29,11 @@ MAX_LOOKAHEAD = 1024
 #: evicted (blamed) slot can be refilled from a second sender
 MAX_STANDBY = 4
 
+#: partials kept aside per round whose chain link doesn't match the
+#: active round's — if WE are the desynced side, a catch-up restarts the
+#: round against the majority link and these are re-offered
+MAX_MISLINKED = 64
+
 
 class RoundManager:
     """Entries are (partial_bytes, prev_round, prev_sig): recovery must
@@ -47,6 +52,9 @@ class RoundManager:
         self._buffered = 0
         self._senders: Dict[int, str] = {}   # signer idx -> sender address
         self._standby: Dict[int, List[tuple]] = {}
+        #: round -> partials whose (prev_round, prev_sig) mismatched the
+        #: active link when they arrived (see _offer)
+        self._mislinked: Dict[int, List[tuple]] = {}
 
     def new_round(self, round: int, prev_round: Optional[int] = None,
                   prev_sig: Optional[bytes] = None) -> asyncio.Queue:
@@ -65,17 +73,31 @@ class RoundManager:
         for entry in self._future.pop(round, []):
             self._buffered -= 1
             self._offer(entry)
+        # a round RE-opened against a fresh link (catch-up advanced the
+        # head mid-round): partials that mismatched the stale link get a
+        # second screening — the majority's quorum may be among them
+        for entry in self._mislinked.pop(round, []):
+            self._offer(entry)
         # drop stale buffered rounds
         for r in [r for r in self._future if r <= round]:
             self._buffered -= len(self._future.pop(r))
+        for r in [r for r in self._mislinked if r < round]:
+            del self._mislinked[r]
         return self._queue
 
     def _offer(self, entry: tuple) -> None:
         if self._link is not None and (entry[1], entry[2]) != self._link:
-            # wrong chain link: the signer is desynced and its partial
-            # signs a different message.  Dropped WITHOUT consuming the
-            # signer's dedup slot, so a corrected partial re-sent after
-            # the peer resyncs can still count toward this round.
+            # wrong chain link: ONE side of this exchange is desynced
+            # and its partial signs a different message.  The signer's
+            # dedup slot is not consumed (a corrected partial re-sent
+            # after a resync still counts) and the entry is kept aside:
+            # if WE turn out to be the stale side, the handler restarts
+            # this round against the caught-up head and `new_round`
+            # re-screens these against the majority link.
+            if self._round is not None:
+                aside = self._mislinked.setdefault(self._round, [])
+                if len(aside) < MAX_MISLINKED:
+                    aside.append(entry)
             return
         idx = self._index_of(entry[0])
         if idx in self._seen:
@@ -101,6 +123,19 @@ class RoundManager:
             self._future.setdefault(round, []).append(entry)
             self._buffered += 1
         # else: stale round — drop
+
+    def invalidate(self) -> None:
+        """A chain reorg moved the head under the active round: every
+        queued/standby partial signs the orphaned link, so the active
+        round state is poison — drop it.  Future-round lookahead stays:
+        `new_round`'s link filter re-screens it against the adopted
+        head when the next round opens."""
+        self._round = None
+        self._queue = None
+        self._seen = set()
+        self._senders = {}
+        self._standby = {}
+        self._link = None
 
     def sender_of(self, idx: int) -> str:
         """Address of the peer whose partial currently holds signer slot

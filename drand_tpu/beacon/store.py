@@ -15,6 +15,23 @@ from typing import Callable, Iterator, List, Optional
 from drand_tpu.beacon.chain import Beacon
 
 
+class RollbackDepthExceeded(RuntimeError):
+    """A rollback would drop more rounds than the configured cap.
+
+    Raised by every backend's ``rollback_to`` with the store untouched —
+    a competitor chain that diverges deeper than the cap must be refused,
+    not partially adopted."""
+
+    def __init__(self, target: int, depth: int, cap: int):
+        super().__init__(
+            f"rollback to round {target} would drop {depth} rounds "
+            f"(depth cap {cap}) — refusing, chain untouched"
+        )
+        self.target = target
+        self.depth = depth
+        self.cap = cap
+
+
 class BeaconStore:
     def __init__(self, path: str = ":memory:"):
         self._db = sqlite3.connect(path, check_same_thread=False)
@@ -80,6 +97,29 @@ class BeaconStore:
             args = (from_round, limit)
         with self._lock:
             rows = self._db.execute(q, args).fetchall()
+        return [self._row_to_beacon(r) for r in rows]
+
+    def rollback_to(self, round: int,
+                    max_depth: Optional[int] = None) -> List[Beacon]:
+        """Drop every beacon with round > `round` (chain reorg).
+
+        Returns the dropped beacons in ascending round order.  Raises
+        :class:`RollbackDepthExceeded` (store untouched) when more than
+        `max_depth` rounds would be dropped; `max_depth=None` is
+        unbounded.  Count + delete run under one lock so a concurrent
+        put cannot slip between the cap check and the delete."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT * FROM beacons WHERE round>? ORDER BY round ASC",
+                (round,),
+            ).fetchall()
+            if max_depth is not None and len(rows) > max_depth:
+                raise RollbackDepthExceeded(round, len(rows), max_depth)
+            if rows:
+                self._db.execute(
+                    "DELETE FROM beacons WHERE round>?", (round,)
+                )
+                self._db.commit()
         return [self._row_to_beacon(r) for r in rows]
 
     def close(self) -> None:
@@ -169,9 +209,18 @@ class CallbackStore:
     def __init__(self, inner: BeaconStore):
         self._inner = inner
         self._callbacks: List[Callable[[Beacon], None]] = []
+        self._rollback_callbacks: List[
+            Callable[[int, List[Beacon]], None]
+        ] = []
 
     def add_callback(self, cb: Callable[[Beacon], None]) -> None:
         self._callbacks.append(cb)
+
+    def add_rollback_callback(
+        self, cb: Callable[[int, List[Beacon]], None]
+    ) -> None:
+        """cb(target_round, dropped_beacons) after every rollback."""
+        self._rollback_callbacks.append(cb)
 
     def put(self, b: Beacon) -> None:
         self._inner.put(b)
@@ -180,6 +229,18 @@ class CallbackStore:
                 cb(b)
             except Exception:  # callbacks must never break the chain
                 pass
+
+    def rollback_to(self, round: int,
+                    max_depth: Optional[int] = None) -> List[Beacon]:
+        dropped = self._inner.rollback_to(round, max_depth=max_depth)
+        if not dropped:  # no-op rollback: nothing for listeners to undo
+            return dropped
+        for cb in list(self._rollback_callbacks):
+            try:
+                cb(round, dropped)
+            except Exception:  # callbacks must never break the chain
+                pass
+        return dropped
 
     def __getattr__(self, name):
         return getattr(self._inner, name)

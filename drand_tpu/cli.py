@@ -720,6 +720,28 @@ def diagnose(status, slo_doc, flight_events) -> list:
         add("warning", "recent_crash",
             f"flight recorder holds a {last.get('kind')} event",
             str({k: v for k, v in last.items() if k != "kind"}))
+    starved = [e for e in flight_events if e.get("kind") == "sync_starved"]
+    if starved:
+        last = starved[-1]
+        add("warning", "sync_starved",
+            f"catch-up starved: every peer failed a full resync pass "
+            f"({last.get('peers_tried')} tried) with the head at "
+            f"{last.get('head_round')} vs scheduled round "
+            f"{last.get('current_round')}",
+            "check peer reachability and drand_sync_failures_total "
+            "reasons; a reorg_beyond_cap reason means a fork diverged "
+            "deeper than the reorg depth cap and needs operator action")
+    refused = [e for e in flight_events
+               if e.get("kind") == "chain.reorg_refused"]
+    if refused:
+        last = refused[-1]
+        add("critical", "reorg_beyond_cap",
+            f"a competing chain from {last.get('peer')} diverges "
+            f"{last.get('depth')} rounds back — beyond the reorg depth "
+            f"cap {last.get('cap')}; the node cannot self-heal",
+            "the fleet has forked deeper than rollback allows: decide "
+            "the canonical branch and re-seed the losing nodes' stores "
+            "(see README 'Fork resolution & reorgs')")
 
     if not findings:
         add("info", "healthy", "no problems detected")

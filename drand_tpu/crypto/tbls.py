@@ -146,6 +146,15 @@ class Scheme:
         self.verify_recovered(pub.commit(), msg, sig)
         return sig
 
+    def invalidate_round_caches(self) -> None:
+        """Drop any cached per-round-message operands.  Called by the
+        beacon handler after a chain reorg: messages derived from the
+        orphaned branch (H(prev_sig||...) rows) can never be asked for
+        again, so holding them only wastes cache slots.  Key-content
+        caches are CORRECT either way (the adopted branch's messages
+        simply miss); this is hygiene, not a safety requirement.
+        Default: nothing cached, nothing to drop."""
+
     # -- batch throughput API (the TPU value-add) ------------------------
 
     def verify_partials_batch(self, pub: PubPoly, msg: bytes,
@@ -880,6 +889,13 @@ class JaxScheme(Scheme):
         out = (self._tower.fp2_decode(sig_host[0]),
                self._tower.fp2_decode(sig_host[1]))
         return ref.g2_to_bytes(out)
+
+    def invalidate_round_caches(self) -> None:
+        # committee plans and chain-operand rows are keyed by committee /
+        # collective key and survive a reorg unchanged; only the
+        # round-message H(m) cache holds orphaned-branch entries
+        with self._msg_lock:
+            self._msg_cache.clear()
 
     def _chain_rows(self, pub_key):
         """Encoded (−G, pk) rows for chain verification, cached per

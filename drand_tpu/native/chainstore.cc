@@ -23,6 +23,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <iterator>
 #include <map>
 #include <mutex>
 #include <string>
@@ -36,6 +37,13 @@
 namespace {
 
 constexpr char kMagic[8] = {'D', 'T', 'C', 'S', 'T', 'O', 'R', '1'};
+
+// A rollback appends a *truncate record*: a normal crc-framed record whose
+// round is this sentinel and whose prev_round carries the rollback target.
+// Replay applies records in log order, so "put 6, truncate >5, put 7"
+// rebuilds the post-reorg index no matter where a crash interrupts —
+// rollback durability costs one append, never a rewrite of the log.
+constexpr uint64_t kTruncSentinel = 0xFFFFFFFFFFFFFFFFull;
 
 uint32_t crc_table[256];
 bool crc_init_done = false;
@@ -139,7 +147,12 @@ bool load(Store* s) {
     if (crc32(buf.data(), len) != crc) break;
     Record r;
     if (!decode_payload(buf.data(), len, &r)) break;
-    s->index[r.round] = std::move(r);
+    if (r.round == kTruncSentinel) {
+      // truncate record: drop every index entry above the target round
+      s->index.erase(s->index.upper_bound(r.prev_round), s->index.end());
+    } else {
+      s->index[r.round] = std::move(r);
+    }
     off += 8 + len;
   }
   if (off < size) {
@@ -148,6 +161,24 @@ bool load(Store* s) {
     if (ftruncate(s->fd, off) != 0) return false;
     if (::fsync(s->fd) != 0) return false;
   }
+  return true;
+}
+
+// Append one crc-framed record to the log (caller holds s->mu).
+bool append_record(Store* s, const Record& r) {
+  std::vector<uint8_t> payload = encode_payload(r);
+  std::vector<uint8_t> rec;
+  put_u32(rec, crc32(payload.data(), payload.size()));
+  put_u32(rec, uint32_t(payload.size()));
+  rec.insert(rec.end(), payload.begin(), payload.end());
+  off_t off = lseek(s->fd, 0, SEEK_END);
+  ssize_t n = pwrite(s->fd, rec.data(), rec.size(), off);
+  if (n != ssize_t(rec.size())) {
+    // keep the log consistent: drop the partial append
+    if (n > 0) (void)!ftruncate(s->fd, off);
+    return false;
+  }
+  if (s->fsync_puts) ::fsync(s->fd);
   return true;
 }
 
@@ -209,29 +240,39 @@ int dtcs_put(void* h, uint64_t round, uint64_t prev_round,
              const uint8_t* prev_sig, uint32_t psl,
              const uint8_t* sig, uint32_t sl) {
   Store* s = static_cast<Store*>(h);
+  if (round == kTruncSentinel) return -4;  // reserved for truncate records
   Record r;
   r.round = round;
   r.prev_round = prev_round;
   r.prev_sig.assign(prev_sig, prev_sig + psl);
   r.sig.assign(sig, sig + sl);
   std::lock_guard<std::mutex> g(s->mu);
-  if (s->fd >= 0) {
-    std::vector<uint8_t> payload = encode_payload(r);
-    std::vector<uint8_t> rec;
-    put_u32(rec, crc32(payload.data(), payload.size()));
-    put_u32(rec, uint32_t(payload.size()));
-    rec.insert(rec.end(), payload.begin(), payload.end());
-    off_t off = lseek(s->fd, 0, SEEK_END);
-    ssize_t n = pwrite(s->fd, rec.data(), rec.size(), off);
-    if (n != ssize_t(rec.size())) {
-      // keep the log consistent: drop the partial append
-      if (n > 0) (void)!ftruncate(s->fd, off);
-      return -1;
-    }
-    if (s->fsync_puts) ::fsync(s->fd);
-  }
+  if (s->fd >= 0 && !append_record(s, r)) return -1;
   s->index[round] = std::move(r);
   return 0;
+}
+
+// Drop every beacon with round > `round` (chain reorg).  max_depth < 0
+// means unbounded; otherwise refuse (rc -3, store untouched) when more
+// than max_depth rounds would be dropped.  Durability: a single truncate
+// record is appended before the in-memory erase, so a crash at any point
+// replays to either the pre- or post-rollback chain, never a mix.
+// Returns the number of rounds dropped, or a negative error code.
+int64_t dtcs_rollback(void* h, uint64_t round, int64_t max_depth) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto from = s->index.upper_bound(round);
+  int64_t depth = int64_t(std::distance(from, s->index.end()));
+  if (depth == 0) return 0;
+  if (max_depth >= 0 && depth > max_depth) return -3;
+  if (s->fd >= 0) {
+    Record t;
+    t.round = kTruncSentinel;
+    t.prev_round = round;
+    if (!append_record(s, t)) return -1;
+  }
+  s->index.erase(from, s->index.end());
+  return depth;
 }
 
 int64_t dtcs_count(void* h) {

@@ -95,6 +95,10 @@ def aggregate(node_docs: Dict[str, dict], watch: Optional[dict] = None,
     worst_stages: Dict[str, dict] = {}
     budget_breaching: list = []
     budget_exceeded_total = 0
+    # fork-resolution fold: fleet-wide reorg count + the deepest one,
+    # named — churn here means partitions keep manufacturing branches
+    reorg_total = 0
+    deepest_reorg: Optional[dict] = None
 
     for name in sorted(node_docs):
         doc = node_docs[name] or {}
@@ -141,6 +145,14 @@ def aggregate(node_docs: Dict[str, dict], watch: Optional[dict] = None,
         if rounds.get("breaching"):
             budget_breaching.append(name)
         budget_exceeded_total += int(rounds.get("exceeded_total") or 0)
+
+        reorgs = chain.get("reorgs") or {}
+        reorg_total += int(reorgs.get("total") or 0)
+        depth = int(reorgs.get("max_depth") or 0)
+        if depth > 0 and (deepest_reorg is None
+                          or depth > deepest_reorg["depth"]):
+            deepest_reorg = {"node": name, "depth": depth,
+                             "last": reorgs.get("last")}
 
         findings = diagnose(status, slo_doc, []) if status else []
         nodes[name] = {
@@ -204,6 +216,7 @@ def aggregate(node_docs: Dict[str, dict], watch: Optional[dict] = None,
                 "exceeded_total": budget_exceeded_total,
             },
         },
+        "reorgs": {"total": reorg_total, "deepest": deepest_reorg},
         "suspects": consensus,
     }
 

@@ -32,6 +32,10 @@ def _chain_status(beacon, now: float) -> Optional[dict]:
             max(0, int((now - group.genesis_time) // group.period) + 1)
             if now >= group.genesis_time else 0
         ),
+        # fork-resolution summary: how often this node rolled back for
+        # a higher verified branch (details ride the chain.reorg
+        # flight events; None when the handler predates the field)
+        "reorgs": getattr(beacon, "reorg_stats", None),
     }
 
 

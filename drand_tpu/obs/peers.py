@@ -51,13 +51,19 @@ _W_INVALID = 0.5
 _W_LATE = 1.0
 _W_STALE = 1.0
 _W_SKEW = 0.5
+#: deliberately soft: serving a branch that later lost a reorg is NOT
+#: forgery — an honest node on the wrong side of a partition does it
+#: too.  The weight only makes a peer that *keeps* feeding us orphaned
+#: branches drift up the ranking, it can never clear min_score alone.
+_W_ORPHANED = 0.2
 
 
 class PeerStats:
     """Mutable per-signer record (lock held by the owning ledger)."""
 
     __slots__ = (
-        "address", "partials", "invalid", "missed", "late", "last_seen",
+        "address", "partials", "invalid", "missed", "late", "orphaned",
+        "last_seen",
         "last_round", "latency_buckets", "latency_last", "latency_ewma",
         "latency_min", "latency_max", "skew_min", "skew_ewma",
         "skew_samples",
@@ -69,6 +75,7 @@ class PeerStats:
         self.invalid = 0
         self.missed = 0
         self.late = 0
+        self.orphaned = 0
         self.last_seen: Optional[float] = None
         self.last_round: Optional[int] = None
         self.latency_buckets = [0] * (len(_LATENCY_FRACTIONS) + 1)
@@ -188,6 +195,21 @@ class PeerLedger:
                     got.discard(address)
         _invalid_counter(address).inc()
 
+    def record_orphaned(self, address: str, ts: float,
+                        rounds: int = 1) -> None:
+        """`address` served us `rounds` beacons that a reorg later
+        orphaned.  This charges the *sender* of the losing branch —
+        never the claimed signer indices inside its beacons (both
+        branches carry valid threshold signatures; blaming signers
+        would frame honest nodes, the same stance as the finalize
+        blame pass).  Kept separate from `invalid`: the fork invariant
+        and the `honest_blamed` check treat invalid as proof of
+        forgery, which an orphaned branch is not."""
+        with self._lock:
+            st = self._get(address)
+            st.orphaned += rounds
+        _orphaned_counter(address).inc(rounds)
+
     def round_complete(self, round: int,
                        contributors: Iterable[str]) -> None:
         """A round finalized; every known signer NOT in `contributors`
@@ -232,6 +254,11 @@ class PeerLedger:
         if st.invalid:
             score += _W_INVALID * min(1.0, st.invalid / 10.0)
             reasons.append(f"{st.invalid} invalid partials")
+        if st.orphaned:
+            score += _W_ORPHANED * min(1.0, st.orphaned / 10.0)
+            reasons.append(
+                f"served {st.orphaned} beacons orphaned by reorgs"
+            )
         if st.latency_ewma is not None and self.period > 0:
             late = st.latency_ewma / self.period
             if late > 0.5:
@@ -268,6 +295,7 @@ class PeerLedger:
                 "invalid": st.invalid,
                 "missed": st.missed,
                 "late": st.late,
+                "orphaned": st.orphaned,
                 "last_seen": st.last_seen,
                 "seconds_ago": (round(now - st.last_seen, 3)
                                 if st.last_seen is not None else None),
@@ -326,6 +354,14 @@ def _invalid_counter(peer: str):
     return metrics.counter(
         "drand_peer_invalid_partials_total",
         "partials that failed signature verification",
+        labels={"peer": peer},
+    )
+
+
+def _orphaned_counter(peer: str):
+    return metrics.counter(
+        "drand_peer_orphaned_beacons_total",
+        "beacons served by this peer that a chain reorg later orphaned",
         labels={"peer": peer},
     )
 

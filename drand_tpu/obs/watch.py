@@ -16,11 +16,19 @@ On top of the verified view the watcher edge-triggers typed events —
 each fires once per state change, into the local event list and an
 injectable flight recorder:
 
-* ``watch_fork``         — two verified branches disagree; carries the
-  divergence round (the first round where the histories conflict:
-  either two different beacons for one round, or one chain *bridging
-  over* a round another chain finalized).  This is the detection half
-  of ROADMAP direction 1; the resolution policy lands separately.
+* ``watch_fork``         — two verified branches disagree AND neither
+  wins: carries the divergence round (the first round where the
+  histories conflict: either two different beacons for one round, or
+  one chain *bridging over* a round another chain finalized).  Pages
+  only for unresolved conflicts — equal heads, or a branch the watcher
+  cannot root in its canonical chain.
+* ``watch_reorg``        — a verified conflicting branch whose head
+  STRICTLY exceeds the canonical head was adopted (the same
+  highest-round-fully-verified-chain-wins policy the nodes run, see
+  `beacon.handler._resolve_fork`): the canonical chain rolled back to
+  the divergence round and took the branch; fork entries the adoption
+  resolves are cleared, so `drand_watch_fork_detected` falls back to 0
+  instead of paging forever on a self-healed fork.
 * ``watch_stalled`` / ``watch_resumed`` — no verified head progress for
   `stall_periods` beacon periods while the schedule marched >= 2
   rounds ahead.
@@ -61,6 +69,10 @@ _bad_beacons = metrics.counter(
     "fetched beacons that FAILED the pairing check (forgeries)")
 _forks_total = metrics.counter(
     "drand_watch_forks_total", "distinct chain divergences detected")
+_reorgs_total = metrics.counter(
+    "drand_watch_reorgs_total",
+    "verified higher-head branches the watcher's canonical chain "
+    "adopted (followed reorgs)")
 _fork_gauge = metrics.gauge(
     "drand_watch_fork_detected",
     "number of distinct verified-chain divergences currently known "
@@ -126,6 +138,10 @@ class ChainWatcher:
         self.peers.setdefault(addr, {
             "head": 0, "tail": None, "status": "unknown",
             "lagging": False, "bad": 0,
+            # verified-but-unadopted branch beacons: kept so a branch
+            # that outgrows the canonical head across SEVERAL polls can
+            # still be rooted at its divergence point and adopted
+            "branch": [],
         })
 
     def _now(self) -> float:
@@ -216,51 +232,132 @@ class ChainWatcher:
         st["tail"] = good[-1]
         st["head"] = good[-1].round
         _verified.inc(len(good))
-        for b in good:
-            self._observe(addr, b)
+        self._fold(addr, good)
         if st["lagging"] and st["head"] > old_head:
             self._event("watch_catchup", peer=addr,
                         from_round=old_head, to_round=st["head"])
 
-    # -- fork detection ----------------------------------------------------
+    # -- fork detection / resolution ---------------------------------------
 
-    def _observe(self, addr: str, b: Beacon) -> None:
-        """Fold one VERIFIED beacon into the canonical chain, flagging
-        any disagreement as a fork with its divergence round."""
+    def _fold(self, addr: str, good: List[Beacon]) -> None:
+        """Fold a verified segment into the canonical chain.
+
+        Beacons that agree with (or cleanly extend) the canonical chain
+        are adopted one by one.  From the FIRST conflicting beacon on,
+        the rest of the segment is treated as one competing branch; the
+        same policy the nodes run then decides: a branch whose verified
+        head strictly exceeds the canonical head is ADOPTED as a reorg
+        (``watch_reorg``), anything else pages ``watch_fork``."""
+        st = self.peers[addr]
+        suffix: List[Beacon] = []
+        divergence, detail = 0, ""
+        for b in good:
+            if suffix:
+                suffix.append(b)  # the rest of the batch rides the branch
+                continue
+            conflict = self._observe(addr, b)
+            if conflict is not None:
+                divergence, detail = conflict
+                suffix = [b]
+        if not suffix:
+            st["branch"] = []  # peer is back on the canonical chain
+            return
+        # a conflicting branch may take several polls to outgrow the
+        # canonical head: stitch this poll's run onto the unadopted
+        # branch kept from the last one when they link
+        branch = st.get("branch") or []
+        if branch and (branch[-1].round == suffix[0].prev_round
+                       and branch[-1].signature == suffix[0].prev_sig):
+            branch = branch + suffix
+        else:
+            branch = suffix
+        st["branch"] = branch
+        cmax = max(self.chain, default=0)
+        if branch[-1].round > cmax and self._reorg(addr, branch):
+            st["branch"] = []
+        else:
+            self._fork(addr, divergence, detail)
+
+    def _observe(self, addr: str, b: Beacon):
+        """Fold one VERIFIED beacon into the canonical chain.  Returns
+        ``None`` on agreement/extension, else ``(divergence_round,
+        detail)`` for a beacon that conflicts with canonical history
+        (nothing is adopted in that case — `_fold` decides whether the
+        conflict resolves as a reorg or pages as a fork)."""
         have = self.chain.get(b.round)
         if have is not None:
             if (have.signature, have.prev_round, have.prev_sig) != \
                     (b.signature, b.prev_round, b.prev_sig):
-                self._fork(addr, b.round,
-                           f"{addr} holds a different beacon for round "
-                           f"{b.round} than the canonical chain")
-            return
+                return (b.round,
+                        f"{addr} holds a different beacon for round "
+                        f"{b.round} than the canonical chain")
+            return None
         # the incoming link bridges over rounds the canonical chain has
         for r in range(b.prev_round + 1, b.round):
             if r in self.chain:
-                self._fork(addr, r,
-                           f"{addr}'s chain bridges over round {r} "
-                           f"({b.prev_round}->{b.round}) but the "
-                           f"canonical chain finalized it")
-                return  # forked branch: do not adopt
+                return (r,
+                        f"{addr}'s chain bridges over round {r} "
+                        f"({b.prev_round}->{b.round}) but the "
+                        f"canonical chain finalized it")
         # a previously-adopted link bridged over THIS round
         bridger = self._skipped.get(b.round)
         if bridger is not None:
-            self._fork(addr, b.round,
-                       f"{addr} finalized round {b.round}, which the "
-                       f"canonical chain bridged over "
-                       f"(link into round {bridger})")
-            return
+            return (b.round,
+                    f"{addr} finalized round {b.round}, which the "
+                    f"canonical chain bridged over "
+                    f"(link into round {bridger})")
         prev = self.chain.get(b.prev_round)
         if prev is not None and prev.signature != b.prev_sig:
-            self._fork(addr, b.round,
-                       f"{addr}'s round {b.round} links a different "
-                       f"round-{b.prev_round} signature than the "
-                       f"canonical chain")
-            return
+            return (b.round,
+                    f"{addr}'s round {b.round} links a different "
+                    f"round-{b.prev_round} signature than the "
+                    f"canonical chain")
         self.chain[b.round] = b
         for r in range(b.prev_round + 1, b.round):
             self._skipped[r] = b.round
+        return None
+
+    def _reorg(self, addr: str, branch: List[Beacon]) -> bool:
+        """Adopt a verified competing branch: highest round wins.
+
+        The branch must root at a beacon the canonical chain agrees on
+        (its first link's (prev_round, prev_sig) matches canonical) and
+        link internally; the watcher then drops every canonical round
+        past the divergence point, takes the branch, and clears fork
+        entries the adoption resolves.  Returns False — canonical chain
+        untouched — when the branch cannot be rooted."""
+        base = branch[0].prev_round
+        anchor = self.chain.get(base)
+        if base > 0 and (anchor is None
+                         or anchor.signature != branch[0].prev_sig):
+            return False  # cannot root the branch in canonical history
+        for p, b in zip(branch, branch[1:]):
+            if b.prev_round != p.round or b.prev_sig != p.signature:
+                return False  # stitched branch does not link
+        old_head = max(self.chain, default=0)
+        dropped = sorted(r for r in self.chain if r > base)
+        for r in dropped:
+            del self.chain[r]
+        for r in [r for r, br in self._skipped.items() if br > base]:
+            del self._skipped[r]
+        for b in branch:
+            self.chain[b.round] = b
+            for r in range(b.prev_round + 1, b.round):
+                self._skipped[r] = b.round
+        # fork entries rooted past the divergence point are resolved by
+        # the adoption: clear them so drand_watch_fork_detected drops
+        # back to 0 instead of paging on a healed fork forever
+        resolved = [f for f in self.forks
+                    if f["divergence_round"] > base]
+        self.forks = [f for f in self.forks
+                      if f["divergence_round"] <= base]
+        for f in resolved:
+            self._fork_keys.discard((f["peer"], f["divergence_round"]))
+        _reorgs_total.inc()
+        self._event("watch_reorg", peer=addr, divergence_round=base,
+                    depth=len(dropped), old_head=old_head,
+                    new_head=branch[-1].round)
+        return True
 
     def _fork(self, peer: str, divergence_round: int, detail: str) -> None:
         key = (peer, divergence_round)

@@ -104,6 +104,7 @@ class SimNode:
         )
         self.handler = BeaconHandler(cfg, self.store, self._build_client())
         self.handler.add_callback(self._on_stored)
+        self.handler.add_reorg_callback(self._on_reorg)
         return self.handler
 
     def _on_stored(self, beacon) -> None:
@@ -111,6 +112,19 @@ class SimNode:
             "round_stored", node=self.address, round=beacon.round,
             prev_round=beacon.prev_round,
             sig=beacon.signature[:8].hex(),
+            incarnation=self.incarnation,
+        )
+
+    def _on_reorg(self, ev: dict) -> None:
+        # every field the handler passes is deterministic (rounds and
+        # addresses, no wall-clock), so the event joins the
+        # byte-identical replay log
+        self.world.recorder.record(
+            "chain_reorg", node=self.address,
+            peer=ev.get("peer", ""), via=ev.get("via", ""),
+            divergence_round=ev.get("divergence_round"),
+            depth=ev.get("depth"),
+            old_head=ev.get("old_head"), new_head=ev.get("new_head"),
             incarnation=self.incarnation,
         )
 

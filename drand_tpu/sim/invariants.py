@@ -13,7 +13,15 @@ Invariant catalogue (the `kind` on each Violation):
   round has two different beacons, or one node's chain *bridges over* a
   round another honest node finalized (a gap between consecutive stored
   beacons asserts "those rounds never happened"; an honest peer holding
-  one of them proves divergent chains).
+  one of them proves divergent chains).  Fork resolution makes a
+  divergence at ONE checkpoint legal — it may be mid-reorg — so the
+  incremental checker only records a fork that persists across two
+  consecutive checkpoints (see `InvariantState.checkpoint`).
+* ``converged_single_chain`` — post-run only: after the scenario
+  settles, every honest up node must hold the SAME chain (byte-equal
+  beacons on all common rounds, one common head).  The per-checkpoint
+  grace above does not apply here: a fork that survives to the end of
+  the run is a resolution failure, not a transient.
 * ``chain_linkage`` — a single store's chain doesn't link: some beacon's
   (prev_round, prev_sig) doesn't match the beacon stored before it.
 * ``chain_verify`` — a stored beacon's group signature fails pairing
@@ -125,6 +133,32 @@ def check_chain_verifies(addr: str, store, scheme, dist_key,
     ]
 
 
+def check_converged_single_chain(
+        stores: Dict[str, object]) -> List[Violation]:
+    """Post-run convergence: the honest (up) fleet holds ONE chain.
+
+    Byte-level agreement on every common round (via `check_forks`) plus
+    a single common head.  Run once after the last checkpoint settles;
+    unlike the incremental fork check there is no mid-reorg grace —
+    a divergence that outlives the run means resolution failed."""
+    out = [
+        Violation("converged_single_chain", v.node, v.round, v.detail)
+        for v in check_forks(stores)
+    ]
+    heads = {a: (st.last().round if st.last() else 0)
+             for a, st in sorted(stores.items())}
+    if heads and len(set(heads.values())) > 1:
+        hi = max(heads.values())
+        for a in sorted(heads):
+            if heads[a] != hi:
+                out.append(Violation(
+                    "converged_single_chain", a, heads[a],
+                    f"{a} ended at head {heads[a]} while the fleet "
+                    f"head is {hi}",
+                ))
+    return out
+
+
 def check_honest_unblamed(nodes: Iterable,
                           honest: Iterable[str]) -> List[Violation]:
     """No honest node's ledger charges an HONEST signer with invalid
@@ -181,6 +215,11 @@ class InvariantState:
     violations: List[Violation] = field(default_factory=list)
     head_samples: List[tuple] = field(default_factory=list)
     verified_to: Dict[str, int] = field(default_factory=dict)
+    #: fork keys observed at the PREVIOUS checkpoint — a fork only
+    #: becomes a violation when it is still there one checkpoint later
+    #: (fork resolution legitimately shows a one-checkpoint divergence
+    #: while the losing branch reorgs onto the winner)
+    fork_pending: set = field(default_factory=set)
 
     def _add(self, vs: List[Violation]) -> List[Violation]:
         fresh = []
@@ -208,7 +247,11 @@ class InvariantState:
                 from_round=frm))
             head = n.store.last()
             self.verified_to[n.address] = head.round if head else 0
-        found.extend(check_forks(stores))
+        fork_now = check_forks(stores)
+        now_keys = {(v.node, v.round, v.detail) for v in fork_now}
+        found.extend(v for v in fork_now
+                     if (v.node, v.round, v.detail) in self.fork_pending)
+        self.fork_pending = now_keys
         found.extend(check_honest_unblamed(
             [n for n in honest_nodes if n.up and n.handler is not None],
             world.honest))

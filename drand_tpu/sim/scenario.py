@@ -28,6 +28,7 @@ from drand_tpu.sim.harness import SimWorld
 from drand_tpu.sim.invariants import (
     InvariantState,
     check_byzantine_blamed,
+    check_converged_single_chain,
 )
 
 
@@ -67,6 +68,13 @@ class Scenario:
     #: every lying Byzantine node must be charged invalid partials by
     #: some honest ledger before the run ends
     expect_blamed: bool = False
+    #: at least one honest node must ADOPT a chain reorg during the run
+    #: (a `chain_reorg` event in the log; the scenario manufactures a
+    #: fork and demands it be resolved, not merely detected)
+    require_reorg: bool = False
+    #: post-run `converged_single_chain` invariant: every honest up node
+    #: ends holding the same chain with one common head
+    require_converged: bool = False
     #: scenario scripts exact node indexes/links; --nodes is refused
     fixed_topology: bool = False
     notes: str = ""
@@ -283,6 +291,19 @@ async def _run_world(scn: Scenario, seed: int,
                 failures.append(
                     f"{node.address} did not converge: head "
                     f"{head_round} < {scn.rounds - 1}")
+
+    if scn.require_converged:
+        up_stores = {n.address: n.store for n in world.nodes
+                     if n.address in world.honest and n.up}
+        for v in check_converged_single_chain(up_stores):
+            failures.append(f"converged_single_chain: {v.detail}")
+    if scn.require_reorg:
+        reorgs = sum(1 for ev in world.recorder.snapshot()
+                     if ev.get("kind") == "chain_reorg")
+        if not reorgs:
+            failures.append(
+                "expected at least one adopted chain reorg; none "
+                "happened")
 
     if scn.expect_blamed:
         liars = [world.nodes[i].address

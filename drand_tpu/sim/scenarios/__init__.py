@@ -22,12 +22,13 @@ from drand_tpu.sim.scenarios import (  # noqa: E402
     gateway_kill,
     lossy_link,
     partition,
+    reorg_chaos,
 )
 
 _MODULES = (
     partition, asym_link, clock_skew, crash_restart, byz_liar,
     byz_stale, byz_equivocate, device_fault, lossy_link, fork_stall,
-    gateway_kill,
+    gateway_kill, reorg_chaos,
 )
 
 SCENARIOS: Dict[str, object] = {m.build().name: m.build for m in _MODULES}

@@ -1,31 +1,43 @@
-"""The known half-partition fork stall (ROADMAP direction 1).
+"""The partition fork — now the fork-RESOLUTION acceptance gate.
 
-This scenario REPRODUCES A REAL BUG on purpose.  It is the acceptance
-gate for the future fork-resolution PR: today it passes by expecting
-the fork; when fork resolution lands, flip `expect_stall` to False and
-empty the violation sets — the scenario then demands convergence.
+This scenario used to REPRODUCE A REAL BUG (ROADMAP direction 1): a
+fault timeline that manufactures two valid branches used to stall the
+group permanently with every signer honest.  Fork resolution
+(highest-round fully-verified chain wins, `BeaconHandler._resolve_fork`)
+turned that permanent failure into a self-healing event, so the
+expectations flipped: the run is judged PASSED when the SAME class of
+fault ends with every node converged on one verified chain, at least
+one adopted reorg in the log, and nobody blamed.
 
-The mechanism, on a 3-node t=2 group (A=node 0, B=node 1, C=node 2):
+The fork mechanism, on a 3-node t=2 group (A=node 0, B=node 1,
+C=node 2) — quorum-intersection says any two quorums share a node, so
+the fork is built from shared nodes signing against different links,
+which the fault windows make honest (event offsets are seconds after
+genesis; round k opens at genesis + (k-1)*period):
 
-1. B goes deaf (inbound blocked, outbound open) after round 3.  A and C
-   keep finalizing rounds 4-5; B's head freezes at 3 while its ticker
-   keeps broadcasting stale-linked partials nobody accepts.
-2. Just before round 6 the fault flips: B heals, C goes deaf.  Round 6:
-   A and C sign against head 5; C's partial reaches A -> A finalizes 6.
-   B, seeing round-6 partials ahead of its head, catch-up syncs from A —
-   but the sync snapshot was taken BEFORE A stored 6, so B lands on
-   head 5.  C, deaf, is stuck at 5 too.
-3. Round 7: A signs against 6; B and C both sign against 5 — B's round
-   manager pins the stale link, C's matching stale partial arrives, and
-   t=2 is met: **B finalizes a forked round 7 with prev_round=5**,
-   even though round 6 exists.
-4. Nobody shares a chain link anymore.  A rejects B's fork during sync
-   ("chain link broken"), B and C can't help each other, and the group
-   stalls permanently: the doctor flags `stalled_chain` on every honest
-   node, yet no peer ledger charges anyone — every signer was honest.
+1. Round 7 (opens +180): B and C are deaf (inbound blocked, outbound
+   open).  All three sign 7-on-6; B's and C's partials still reach A,
+   so **only A finalizes round 7** — B and C never hear the result and
+   stay at head 6.
+2. Round 8 (opens +210): B and C heal, but the fault flips to a
+   partition isolating A.  B and C both sign 8 against their head 6,
+   exchange partials, and meet t=2: **a fully-valid round 8 with
+   prev_round=6**, bridging over the round 7 that A finalized.  A,
+   alone with its 8-on-7 partial, cannot finalize — two verified
+   branches now exist: A's ``..6,7`` vs B/C's ``..6,8``.
+3. Resolution: the partition heals before round 9 (opens +240).  B/C's
+   round-9 partials advertise a link (8) ahead of A's head — A
+   resyncs, hits "chain link broken" on the 8-on-6 beacon, walks back
+   to the divergence point (round 6), batch-verifies the competitor
+   branch, and adopts it: A rolls back its orphaned 7 and takes
+   ``8,9`` (highest verified head wins, a depth-1 reorg).  Round 7
+   ends up orphaned on every chain; the fleet converges at head 9.
 
-The run is judged PASSED when the stall occurs, the doctor flags it,
-the fork-class invariant fires, and no honest node is blamed.
+The per-checkpoint fork invariant tolerates the one-checkpoint
+transient while A still holds its orphaned 7; nothing may persist.  The
+attached watchdog (`--watch` runs) follows the reorg instead of paging
+`watch_fork` forever — `tests/test_sim.py` and `tests/test_watch.py`
+pin both behaviors.
 """
 
 from drand_tpu.sim.scenario import Scenario, SimEvent
@@ -34,18 +46,30 @@ from drand_tpu.sim.scenario import Scenario, SimEvent
 def build() -> Scenario:
     return Scenario(
         name="fork_stall",
-        summary="half-partition flip makes a mid-catch-up node finalize "
-                "a forked round; permanent stall (known bug, gates the "
-                "fork-resolution PR)",
+        summary="deaf round + partition flip forks the chain between "
+                "two honest quorums; the fleet must reorg onto the "
+                "highest verified branch and converge (gates fork "
+                "resolution)",
         n=3, threshold=2, rounds=9,
         fixed_topology=True,
         events=[
-            SimEvent(at=65.0, action="deaf", args={"node": 1}),
-            SimEvent(at=125.0, action="undeaf", args={"node": 1}),
-            SimEvent(at=125.0, action="deaf", args={"node": 2}),
+            # round 7 (opens +180): B and C deaf -> only A finalizes 7
+            SimEvent(at=155.0, action="deaf", args={"node": 1}),
+            SimEvent(at=155.0, action="deaf", args={"node": 2}),
+            # round 8 (opens +210): B and C heal behind a partition
+            # that isolates A -> B+C finalize a valid 8-on-6
+            SimEvent(at=185.0, action="undeaf", args={"node": 1}),
+            SimEvent(at=185.0, action="undeaf", args={"node": 2}),
+            SimEvent(at=185.0, action="partition",
+                     args={"groups": [[1, 2], [0]]}),
+            # heal before round 9 (opens +240): A discovers the higher
+            # verified branch and must reorg its 7 away
+            SimEvent(at=215.0, action="heal", args={}),
         ],
-        expect_stall=True,
-        require_violations=frozenset({"chain_linkage"}),
-        allow_violations=frozenset({"chain_linkage", "fork"}),
-        notes="flip expect_stall/violations when fork resolution lands",
+        expect_stall=False,
+        require_violations=frozenset(),
+        allow_violations=frozenset(),
+        require_reorg=True,
+        require_converged=True,
+        notes="was the known-bug repro; now demands self-healing",
     )

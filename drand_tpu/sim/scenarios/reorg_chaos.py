@@ -1,0 +1,63 @@
+"""Reorg under load: a manufactured fork plus repeated partition flips.
+
+`fork_stall` gates the resolution mechanism on its minimal fork.  This
+scenario is the endurance version: the same fork cycle runs early (B
+and C deaf for one round so only A finalizes it, then the fault flips
+to a partition isolating A while B+C finalize a bridging quorum —
+forcing A into a reorg), and then the fleet keeps finalizing through
+THREE back-to-back partition flips, each isolating a different node
+behind a healthy t=2 majority.  Every flip makes the minority node
+catch-up-sync while the majority keeps finalizing — exactly the
+stale-sync race window (`SyncSuperseded`) and the mid-round head-move
+window (`_refresh_round_task`) that used to leave a healed node
+trailing the fleet by one round forever.
+
+Judged like fork_stall: no stall, at least one adopted reorg somewhere
+in the run, every honest up node converged on ONE verified chain at the
+end, and nobody blamed.  Seventeen rounds — the last two quiet — so
+convergence is demanded *after* sustained churn, not just after the
+scripted fork.
+"""
+
+from drand_tpu.sim.scenario import Scenario, SimEvent
+
+
+def build() -> Scenario:
+    return Scenario(
+        name="reorg_chaos",
+        summary="fork + reorg early, then three partition flips under "
+                "continued load; the fleet must keep converging on one "
+                "verified chain (endurance test for fork resolution)",
+        n=3, threshold=2, rounds=17,
+        fixed_topology=True,
+        events=[
+            # fork cycle (fork_stall's timing): B and C deaf for round
+            # 7 (only A finalizes it), then a partition isolates A
+            # while B+C finalize a bridging 8-on-6 -> A reorgs
+            SimEvent(at=155.0, action="deaf", args={"node": 1}),
+            SimEvent(at=155.0, action="deaf", args={"node": 2}),
+            SimEvent(at=185.0, action="undeaf", args={"node": 1}),
+            SimEvent(at=185.0, action="undeaf", args={"node": 2}),
+            SimEvent(at=185.0, action="partition",
+                     args={"groups": [[1, 2], [0]]}),
+            SimEvent(at=215.0, action="heal", args={}),
+            # partition churn: isolate each node in turn behind a
+            # finalizing t=2 majority, heal, repeat — every heal races
+            # the minority's catch-up sync against live finalizes
+            SimEvent(at=275.0, action="partition",
+                     args={"groups": [[0, 1], [2]]}),
+            SimEvent(at=305.0, action="heal", args={}),
+            SimEvent(at=335.0, action="partition",
+                     args={"groups": [[0, 2], [1]]}),
+            SimEvent(at=365.0, action="heal", args={}),
+            SimEvent(at=395.0, action="partition",
+                     args={"groups": [[1, 2], [0]]}),
+            SimEvent(at=425.0, action="heal", args={}),
+        ],
+        expect_stall=False,
+        require_violations=frozenset(),
+        allow_violations=frozenset(),
+        require_reorg=True,
+        require_converged=True,
+        notes="endurance companion to fork_stall",
+    )

@@ -67,6 +67,32 @@ def test_diagnose_flags_low_budget_and_crash_events():
     assert "recent_crash" in kinds
 
 
+def test_diagnose_flags_sync_starvation_and_beyond_cap_fork():
+    """The two fork-resolution findings: a starved catch-up loop is a
+    warning; a competing branch beyond the rollback cap is critical —
+    it never self-heals (README 'Fork resolution & reorgs')."""
+    events = [
+        {"kind": "sync_starved", "peers_tried": 3,
+         "head_round": 40, "current_round": 55},
+        {"kind": "chain.reorg_refused", "peer": "10.0.0.9:8080",
+         "divergence_round": 12, "depth": 70, "cap": 64},
+    ]
+    findings = diagnose({}, {"objectives": {}}, events)
+    by_kind = {f["kind"]: f for f in findings}
+    starved = by_kind["sync_starved"]
+    assert starved["severity"] == "warning"
+    assert "3 tried" in starved["summary"]
+    assert "40" in starved["summary"] and "55" in starved["summary"]
+    assert "drand_sync_failures_total" in starved["detail"]
+    refused = by_kind["reorg_beyond_cap"]
+    assert refused["severity"] == "critical"
+    assert "10.0.0.9:8080" in refused["summary"]
+    assert "70" in refused["summary"] and "64" in refused["summary"]
+    assert "Fork resolution" in refused["detail"]
+    # critical sorts ahead of the starvation warning
+    assert findings[0]["kind"] == "reorg_beyond_cap"
+
+
 # -- acceptance scenarios on a live 2-node network -----------------------
 
 async def test_doctor_flags_injected_lagging_peer():

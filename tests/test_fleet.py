@@ -90,6 +90,28 @@ def test_suspect_consensus_needs_multiple_reporters_to_rank_first():
     assert doc["suspects"][1]["reported_by"] == ["c"]
 
 
+def test_reorg_fold_counts_and_names_deepest():
+    """Per-node reorg summaries (chain.reorgs in /v1/status) fold into
+    a fleet total plus the deepest reorg with its node named."""
+    a = status_doc(9, 9)
+    a["chain"]["reorgs"] = {"total": 2, "max_depth": 1,
+                            "last": {"divergence_round": 6, "depth": 1}}
+    b = status_doc(9, 9)
+    b["chain"]["reorgs"] = {"total": 1, "max_depth": 5,
+                            "last": {"divergence_round": 2, "depth": 5}}
+    doc = aggregate({
+        "a": {"status": a, "slo": None},
+        "b": {"status": b, "slo": None},
+        "c": {"status": status_doc(9, 9), "slo": None},  # no field: old node
+    })
+    assert doc["reorgs"]["total"] == 3
+    deepest = doc["reorgs"]["deepest"]
+    assert deepest["node"] == "b" and deepest["depth"] == 5
+    assert deepest["last"]["divergence_round"] == 2
+    quiet = aggregate({"c": {"status": status_doc(9, 9), "slo": None}})
+    assert quiet["reorgs"] == {"total": 0, "deepest": None}
+
+
 def test_watch_disputes_flag_unbacked_head_claims():
     """A node that CLAIMS a head the watcher could not verify (beyond
     one round of polling slack) becomes a dispute — the Byzantine node

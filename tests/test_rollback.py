@@ -1,0 +1,164 @@
+"""Bounded rollback API: the storage half of fork resolution.
+
+`rollback_to(round, max_depth)` must behave identically on the sqlite
+store and the native append-log — same dropped beacons, same typed
+refusal beyond the depth cap with the chain untouched, and full
+cursor/range/len/last coherence after a rollback followed by re-puts
+(the reorg adoption path).  Property-style: randomized chains with gaps
+are rolled back at every possible target and cross-checked between the
+two backends.  Crash-mid-rollback durability for the native truncate
+record lives in tests/test_restart.py.
+"""
+
+import random
+
+import pytest
+
+from drand_tpu.beacon import (
+    Beacon,
+    BeaconStore,
+    CallbackStore,
+    RollbackDepthExceeded,
+)
+from drand_tpu.beacon.native_store import NativeBeaconStore, available
+
+
+def mk(i, prev=None, tag=0):
+    return Beacon(
+        round=i, prev_round=prev if prev is not None else max(0, i - 1),
+        prev_sig=bytes([i % 251, tag % 251]) * 48,
+        signature=bytes([(i + 1) % 251, tag % 251]) * 48,
+    )
+
+
+def chain_rounds(seed, n=12):
+    """A gappy ascending round sequence starting at 0 (genesis)."""
+    rng = random.Random(seed)
+    rounds, r = [0], 0
+    for _ in range(n):
+        r += rng.choice((1, 1, 1, 2, 3))  # gaps are legal chain links
+        rounds.append(r)
+    return rounds
+
+
+def fill(st, rounds):
+    prev = None
+    for i in rounds:
+        st.put(mk(i, prev=prev))
+        prev = i
+
+
+def open_both(tmp_path, name):
+    stores = [BeaconStore(str(tmp_path / f"{name}.sqlite"))]
+    if available():
+        stores.append(NativeBeaconStore(str(tmp_path / f"{name}.native")))
+    return stores
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_rollback_parity_all_targets(tmp_path, seed):
+    """For every possible target round, sqlite and native agree on the
+    dropped suffix and on every read API afterwards."""
+    rounds = chain_rounds(seed)
+    for target in range(rounds[-1] + 2):
+        stores = open_both(tmp_path, f"s{seed}t{target}")
+        results = []
+        for st in stores:
+            fill(st, rounds)
+            dropped = st.rollback_to(target)
+            results.append((
+                [b.round for b in dropped],
+                len(st),
+                st.last(),
+                st.range_from(0),
+            ))
+            # dropped is exactly the suffix past the target, ascending
+            expect = [r for r in rounds if r > target]
+            assert [b.round for b in dropped] == expect
+            assert all(st.get(r) is None for r in expect)
+            kept = [r for r in rounds if r <= target]
+            assert [b.round for b in st.range_from(0)] == kept
+            assert len(st) == len(kept)
+            if kept:
+                assert st.last().round == kept[-1]
+            else:
+                assert st.last() is None
+            st.close()
+        assert all(r == results[0] for r in results[1:])
+
+
+@pytest.mark.parametrize("seed", [4, 5])
+def test_rollback_depth_cap_refusal_leaves_chain_untouched(tmp_path, seed):
+    rounds = chain_rounds(seed)
+    for st in open_both(tmp_path, f"cap{seed}"):
+        fill(st, rounds)
+        before = st.range_from(0)
+        target = rounds[3]
+        depth = sum(1 for r in rounds if r > target)
+        with pytest.raises(RollbackDepthExceeded) as ei:
+            st.rollback_to(target, max_depth=depth - 1)
+        assert ei.value.depth == depth
+        assert ei.value.cap == depth - 1
+        # refusal is all-or-nothing: the chain did not move
+        assert st.range_from(0) == before
+        assert st.last() == before[-1]
+        # the exact depth is allowed
+        dropped = st.rollback_to(target, max_depth=depth)
+        assert len(dropped) == depth
+        st.close()
+
+
+def test_rollback_then_reput_cursor_coherent(tmp_path):
+    """The reorg adoption sequence: rollback, then put the competing
+    branch.  Cursor traversal, seek, range_from, len and last must all
+    see the post-reorg chain only."""
+    rounds = [0, 1, 2, 3, 4, 5, 6]
+    for st in open_both(tmp_path, "reorg"):
+        fill(st, rounds)
+        st.rollback_to(4)
+        # adopt a branch that bridges 4 -> 6 -> 8 (different beacons)
+        st.put(mk(6, prev=4, tag=9))
+        st.put(mk(8, prev=6, tag=9))
+        want = [0, 1, 2, 3, 4, 6, 8]
+        assert [b.round for b in st.range_from(0)] == want
+        assert len(st) == len(want)
+        assert st.last().round == 8
+        assert st.get(5) is None
+        assert st.get(6) == mk(6, prev=4, tag=9)
+        cur = st.cursor()
+        seen = []
+        b = cur.first()
+        while b is not None:
+            seen.append(b.round)
+            b = cur.next()
+        assert seen == want
+        assert cur.seek(5).round == 6  # seek lands past the hole
+        assert cur.last().round == 8
+        st.close()
+
+
+def test_rollback_noop_and_empty(tmp_path):
+    for st in open_both(tmp_path, "noop"):
+        assert st.rollback_to(10) == []  # empty store: nothing to drop
+        fill(st, [0, 1, 2])
+        assert st.rollback_to(2) == []   # target at head: no-op
+        assert st.rollback_to(99) == []  # target past head: no-op
+        assert len(st) == 3
+        # max_depth never triggers on a no-op
+        assert st.rollback_to(2, max_depth=0) == []
+        st.close()
+
+
+def test_callback_store_fires_rollback_callbacks(tmp_path):
+    inner = BeaconStore(str(tmp_path / "cb.sqlite"))
+    calls = []
+    st = CallbackStore(inner)
+    st.add_rollback_callback(lambda tgt, dropped: calls.append(
+        (tgt, [b.round for b in dropped])))
+    fill(st, [0, 1, 2, 3])
+    st.rollback_to(1)
+    assert calls == [(1, [2, 3])]
+    # no-op rollbacks don't fire
+    st.rollback_to(1)
+    assert len(calls) == 1
+    st.close()

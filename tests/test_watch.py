@@ -2,12 +2,16 @@
 
 The watcher's contract is that NOTHING a peer merely claims enters its
 world view — every beacon must pass the pairing check — and that fork /
-stall / lag conditions edge-trigger exactly one typed event each.  Unit
-tests drive a `ChainWatcher` over stub fetchers with a fake scheme whose
-verification is a keyed hash (so forgeries and fork branches are cheap
-to mint); the integration test attaches the watcher to the `fork_stall`
-sim scenario and checks it names the known divergence round and flags
-the stall within the promised window, with zero in-node cooperation.
+stall / lag conditions edge-trigger exactly one typed event each.  A
+verified conflicting branch whose head strictly exceeds the canonical
+head is FOLLOWED (``watch_reorg``: same highest-verified-head policy the
+nodes run) instead of paged; unresolved conflicts still page
+``watch_fork``.  Unit tests drive a `ChainWatcher` over stub fetchers
+with a fake scheme whose verification is a keyed hash (so forgeries and
+fork branches are cheap to mint); the integration test attaches the
+watcher to the `fork_stall` sim scenario and checks it follows the
+fleet's reorg to convergence — no standing fork, no stall — with zero
+in-node cooperation.
 """
 
 import hashlib
@@ -173,13 +177,16 @@ async def test_stale_head_liar_cannot_inflate_verified_heads():
                for e in w.events)
 
 
-# -- fork detection ---------------------------------------------------------
+# -- fork detection / resolution --------------------------------------------
 
 
-async def test_bridging_fork_names_divergence_round_edge_triggered():
-    """The fork_stall shape in miniature: one peer finalizes round 6,
-    the other's chain bridges 5->7 over it.  The divergence round is 6,
-    reported exactly once no matter how often the watcher polls."""
+async def test_bridging_higher_branch_adopted_as_reorg():
+    """The fork_stall shape in miniature: one peer's canonical-adopted
+    chain holds round 6, the other's VERIFIED chain bridges 5->7 over
+    it with a strictly higher head.  The watcher follows — one
+    watch_reorg naming the divergence base and depth, canonical rolls
+    back its 6 and takes the branch, and NO fork pages (the gauge
+    clears)."""
     chain = mk_chain(6)
     branch7 = mk_beacon(7, chain[4])  # prev_round=5: bridges over 6
     w = make_watcher({"a": list_source(chain),
@@ -188,11 +195,67 @@ async def test_bridging_fork_names_divergence_round_edge_triggered():
     await w.poll()
     await w.poll()
 
-    assert [(f["peer"], f["divergence_round"]) for f in w.forks] == \
-        [("b", 6)]
-    assert kinds(w).count("watch_fork") == 1
-    # the branch itself verified: b's head advanced onto it
+    assert w.forks == []
+    assert kinds(w).count("watch_fork") == 0
+    assert kinds(w).count("watch_reorg") == 1
+    ev = next(e for e in w.events if e["kind"] == "watch_reorg")
+    assert ev["peer"] == "b"
+    assert ev["divergence_round"] == 5
+    assert ev["depth"] == 1  # canonical round 6 rolled back
+    assert ev["old_head"] == 6 and ev["new_head"] == 7
+    # canonical chain IS the adopted branch now
+    assert w.chain[7] == branch7
+    assert 6 not in w.chain
     assert w.heads()["b"] == 7
+
+
+async def test_equal_head_bridge_still_pages_fork():
+    """A verified conflicting branch that does NOT beat the canonical
+    head is an unresolved divergence: watch_fork pages (edge-triggered)
+    and the canonical chain is untouched."""
+    chain = mk_chain(7)
+    alt7 = mk_beacon(7, chain[4])  # bridges over 6, head only EQUAL
+    w = make_watcher({"a": list_source(chain),
+                      "b": list_source(chain[:5] + [alt7])})
+    await w.poll()
+    await w.poll()
+
+    assert [(f["peer"], f["divergence_round"]) for f in w.forks] == \
+        [("b", 7)]
+    assert kinds(w).count("watch_fork") == 1
+    assert kinds(w).count("watch_reorg") == 0
+    assert w.chain[7] == chain[6]  # canonical keeps its own round 7
+    assert w.chain[6] == chain[5]
+
+
+async def test_branch_outgrows_canonical_across_polls():
+    """A conflicting branch may need several polls to outgrow the
+    canonical head: the watcher keeps the verified-but-unadopted
+    beacons aside, stitches the next poll's continuation on, and flips
+    the paged fork into a reorg the moment the branch wins — clearing
+    the fork entry so the gauge drops back to 0."""
+    chain = mk_chain(8)
+    b7 = mk_beacon(7, chain[4])       # b's branch: 7-on-5
+    b9 = mk_beacon(9, b7)             # ...then 9-on-7
+    b_store = chain[:5] + [b7]
+    w = make_watcher({"a": list_source(chain),
+                      "b": list_source(b_store)})
+    await w.poll()
+    # branch head 7 < canonical 8: unresolved, pages
+    assert kinds(w).count("watch_fork") == 1
+    assert len(w.forks) == 1
+
+    b_store.append(b9)
+    await w.poll()
+    # branch [7-on-5, 9-on-7] now beats canonical 8: depth-3 reorg
+    assert kinds(w).count("watch_reorg") == 1
+    ev = next(e for e in w.events if e["kind"] == "watch_reorg")
+    assert ev["divergence_round"] == 5
+    assert ev["depth"] == 3          # canonical 6, 7, 8 rolled back
+    assert ev["new_head"] == 9
+    assert w.forks == []             # the paged fork is resolved
+    assert 6 not in w.chain and 8 not in w.chain
+    assert w.chain[9] == b9
 
 
 async def test_same_round_conflict_is_a_fork():
@@ -235,36 +298,41 @@ async def test_stall_flags_after_idle_periods_then_resumes():
 # -- sim integration --------------------------------------------------------
 
 
-def test_fork_stall_watcher_reports_divergence_and_stall():
+def test_fork_stall_watcher_follows_reorg_to_convergence():
     """Acceptance: on the fork_stall scenario the attached watcher must
-    name the known divergence round AND flag the stall within 3 beacon
-    periods — purely by fetching and verifying chains over the fabric,
-    with no in-node cooperation."""
+    FOLLOW the fleet's reorg — a watch_reorg naming the divergence
+    round, no standing watch_fork, no stall — purely by fetching and
+    verifying chains over the fabric, with no in-node cooperation."""
     from drand_tpu.sim.scenario import run_scenario
 
     report = run_scenario("fork_stall", seed=7, watch=True)
     assert report.passed, report.failures
     w = report.watch
     assert w is not None
-    assert w["stalled"] is True
-    assert [(f["peer"], f["divergence_round"]) for f in w["forks"]] == \
-        [("sim01", 6)]
+    assert w["stalled"] is False
+    assert w["forks"] == []          # nothing left paging at the end
+    heads = {p["head"] for p in w["peers"].values()}
+    assert len(heads) == 1           # converged fleet, one verified head
 
     doc = json.loads(report.event_log)
     events = doc["events"] if isinstance(doc, dict) else doc
     by_kind = {}
     for e in events:
         by_kind.setdefault(e["kind"], []).append(e)
-    assert "watch_fork" in by_kind and "watch_stalled" in by_kind
-    fork = by_kind["watch_fork"][0]
-    assert fork["peer"] == "sim01" and fork["divergence_round"] == 6
+    assert "watch_stalled" not in by_kind
+    assert "watch_fork" not in by_kind
+    reorg = by_kind["watch_reorg"][0]
+    # B/C's 8-on-6 branch beats A's 7: divergence at 6, one round rolled
+    assert reorg["peer"] in ("sim01", "sim02")
+    assert reorg["divergence_round"] == 6
+    assert reorg["depth"] == 1
+    assert reorg["new_head"] > reorg["old_head"]
 
     genesis = by_kind["sim_start"][0]["genesis"]
     period = 30.0
-    # last finalized round is 7; the stall must be flagged within 3
-    # periods of its schedule slot
-    stall = by_kind["watch_stalled"][0]
-    assert stall["ts"] <= genesis + (7 + 3) * period
+    # the watcher follows the reorg within 3 periods of the forked
+    # round's schedule slot (round 8 opens at genesis + 7 * period)
+    assert reorg["ts"] <= genesis + (7 + 3) * period
     # the merged timeline carries per-node handler spans too
     assert any(e["kind"] == "node_span" for e in events)
 
@@ -277,7 +345,7 @@ def test_cli_sim_inspect_renders_committed_timeline(capsys):
     rc = cli.main(["sim", "inspect", path])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "watch_fork" in out and "watch_stalled" in out
+    assert "watch_reorg" in out and "chain_reorg" in out
     assert "sim_start" in out and "sim_end" in out
 
     rc = cli.main(["sim", "inspect", path, "--round", "6"])
@@ -285,7 +353,7 @@ def test_cli_sim_inspect_renders_committed_timeline(capsys):
     assert rc == 0
     # the starred watcher row names the divergence
     assert "divergence_round=6" in out
-    assert any(line.startswith("*") and "watch_fork" in line
+    assert any(line.startswith("*") and "watch_reorg" in line
                for line in out.splitlines())
     assert "offsets relative to genesis" in out
 
