@@ -2,8 +2,8 @@
 
 PY ?= python
 
-.PHONY: test test-slow bench bench-suite integration demo warmup \
-	compose-test compose-test-tls clean
+.PHONY: test test-slow test-native-san lint bench bench-suite \
+	integration demo warmup compose-test compose-test-tls clean
 
 # pre-compile device kernels into the persistent XLA cache
 warmup:
@@ -22,6 +22,22 @@ test:
 
 test-slow:
 	$(PY) -m pytest tests/ -x -q -m "slow or not slow"
+
+# native C++ backends rebuilt with ASan+UBSan, test suites run with
+# the sanitizer runtime preloaded (tools/native_san.py sets that up)
+test-native-san:
+	$(PY) tools/native_san.py
+
+# static analysis: the drand-lint ratchet (tools/drandlint) + the
+# mypy --strict beachhead (mypy.ini).  mypy is optional locally —
+# CI always runs it.
+lint:
+	$(PY) -m tools.drandlint --baseline .drandlint-baseline.json
+	@if $(PY) -c "import mypy" 2>/dev/null; then \
+		$(PY) -m mypy; \
+	else \
+		echo "mypy not installed; skipping (the CI lint job runs it)"; \
+	fi
 
 bench:
 	$(PY) bench.py

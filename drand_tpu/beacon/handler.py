@@ -25,7 +25,7 @@ from __future__ import annotations
 import asyncio
 import random
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from drand_tpu.beacon.chain import (
     Beacon,
@@ -262,6 +262,10 @@ class BeaconHandler:
         #: in production, a per-node seeded Random in the simulator
         self._rng = cfg.rng or random
         self._gossip_sem = asyncio.Semaphore(GOSSIP_CONCURRENCY)
+        #: in-flight gossip sends: asyncio keeps only a weak reference to
+        #: running tasks, so a dropped handle can be collected mid-send —
+        #: retained here and cancelled by stop()
+        self._gossip_tasks: Set[asyncio.Task] = set()
         self.pub_poly = cfg.share.pub_poly()
         self.dist_key = cfg.share.public().key()
         self.manager = RoundManager(self.scheme.index_of)
@@ -355,6 +359,8 @@ class BeaconHandler:
         for t in (self._round_task, self._loop_task, self._resync_task):
             if t is not None:
                 t.cancel()
+        for t in list(self._gossip_tasks):
+            t.cancel()
         await asyncio.sleep(0)
         self._stopped.set()
 
@@ -487,7 +493,7 @@ class BeaconHandler:
                     for s in self.peer_ledger.suspects(self.clock.now())}
             peers.sort(key=lambda n: rank.get(n.address, 0.0))
             for node in peers:
-                asyncio.create_task(self._send_packet(node, packet))
+                self._spawn_gossip(node, packet)
 
         with obs_trace.TRACER.span(
             "beacon.aggregate",
@@ -725,6 +731,15 @@ class BeaconHandler:
         task.cancel()
         self._round_task = asyncio.create_task(self._run_round(cur))
 
+    def _spawn_gossip(self, node: Identity,
+                      packet: BeaconPacket) -> asyncio.Task:
+        """Launch one gossip send, retaining the task so it survives GC
+        and stop() can cancel stragglers mid-RPC."""
+        t = asyncio.create_task(self._send_packet(node, packet))
+        self._gossip_tasks.add(t)
+        t.add_done_callback(self._gossip_tasks.discard)
+        return t
+
     async def _send_packet(self, node: Identity,
                            packet: BeaconPacket) -> None:
         async with self._gossip_sem:
@@ -938,7 +953,7 @@ class BeaconHandler:
                         prefetch.cancel()
                         try:
                             await prefetch
-                        except BaseException:
+                        except (Exception, asyncio.CancelledError):
                             pass
                         raise
                     # prefetch already done == the next pull fully

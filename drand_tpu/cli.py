@@ -209,7 +209,9 @@ def cmd_start(args) -> int:
 
         def _graceful(signame: str) -> None:
             flight.RECORDER.record("signal", signal=signame)
-            asyncio.ensure_future(daemon.stop())
+            # request_shutdown retains the stop task in the daemon's
+            # task set — ensure_future here would drop the only handle
+            daemon.request_shutdown()
 
         loop = asyncio.get_running_loop()
         for s in (signal.SIGINT, signal.SIGTERM):
@@ -1029,6 +1031,33 @@ def cmd_bench_diff(args) -> int:
     return 1 if hard else 0
 
 
+def cmd_lint(args) -> int:
+    """Run drand-lint (project-invariant static analysis): hot-path
+    purity, sim determinism, asyncio discipline, registry drift.  Thin
+    shim over ``python -m tools.drandlint`` — the linter lives in the
+    repo checkout (tools/), not the installed package, because it lints
+    the tree, not the wheel."""
+    try:
+        from tools.drandlint.__main__ import main as lint_main
+    except ImportError:
+        print("lint: tools/drandlint not importable — run from a repo "
+              "checkout (or set PYTHONPATH to one)", file=sys.stderr)
+        return 2
+    argv = list(args.paths)
+    argv += ["--root", args.root]
+    if args.json:
+        argv.append("--json")
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    if args.write_baseline:
+        argv.append("--write-baseline")
+    if args.show_suppressed:
+        argv.append("--show-suppressed")
+    if args.list_rules:
+        argv.append("--list-rules")
+    return lint_main(argv)
+
+
 def cmd_sim_inspect(args) -> int:
     """Render a simulation event log (`sim run --out events.json`) as a
     merged cross-node timeline: every fabric/handler/watcher/invariant
@@ -1421,6 +1450,27 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--json", action="store_true",
                    help="machine-readable diff document")
     b.set_defaults(fn=cmd_bench_diff)
+
+    g = sub.add_parser(
+        "lint",
+        help="project-invariant static analysis (exit 1 on violations)",
+    )
+    g.add_argument("paths", nargs="*",
+                   help="files/directories to lint "
+                        "(default: <root>/drand_tpu)")
+    g.add_argument("--root", default=".",
+                   help="repository root (default: cwd)")
+    g.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    g.add_argument("--baseline", metavar="FILE",
+                   help="ratchet file: per-rule counts may only decrease")
+    g.add_argument("--write-baseline", action="store_true",
+                   help="rewrite --baseline with current counts")
+    g.add_argument("--show-suppressed", action="store_true",
+                   help="also print suppressed violations")
+    g.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    g.set_defaults(fn=cmd_lint)
 
     g = sub.add_parser(
         "sim",

@@ -133,6 +133,11 @@ class Drand:
         self._verify_gateway = None
         self._servers: List = []
         self._subscribers: Set[asyncio.Queue] = set()
+        #: background work (partial ingest, stop-from-signal): asyncio
+        #: holds only a weak reference to running tasks, so a dropped
+        #: handle can be collected mid-flight and its exception lost —
+        #: everything spawned via _spawn() lives here until done
+        self._bg_tasks: Set[asyncio.Task] = set()
         self._exit = asyncio.Event()
         self._listen_port: Optional[int] = None
 
@@ -310,7 +315,22 @@ class Drand:
         except Exception as exc:
             log.debug("flight dump failed", err=exc)
 
+    def _spawn(self, coro) -> asyncio.Task:
+        """create_task with retention: the task set keeps the handle
+        alive and stop() can cancel whatever is still in flight."""
+        task = asyncio.get_event_loop().create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
+
     async def stop(self) -> None:
+        # in-flight ingests race the teardown below (they reach into the
+        # beacon handler and chain store); stop() itself may be a _spawn'd
+        # task when shutdown came from a signal, so skip the current one
+        cur = asyncio.current_task()
+        for t in list(self._bg_tasks):
+            if t is not cur:
+                t.cancel()
         self._dump_flight()
         if self.beacon is not None:
             await self.beacon.stop()
@@ -336,7 +356,7 @@ class Drand:
         self._exit.set()
 
     def request_shutdown(self) -> None:
-        asyncio.get_event_loop().create_task(self.stop())
+        self._spawn(self.stop())
 
     async def wait_exit(self) -> None:
         await self._exit.wait()
@@ -609,7 +629,7 @@ class Drand:
                 log.debug("dropping partial", frm=packet.from_address,
                           err=exc)
 
-        asyncio.create_task(_ingest())
+        self._spawn(_ingest())
 
     def serve_sync_chain(self, from_round: int) -> List[Beacon]:
         if self.beacon is None:

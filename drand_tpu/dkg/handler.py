@@ -18,7 +18,7 @@ import asyncio
 import contextlib
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set
 
 from drand_tpu.dkg.pedersen import (
     Deal,
@@ -90,6 +90,9 @@ class DKGHandler:
             asyncio.get_event_loop().create_future()
         )
         self._timer_task: Optional[asyncio.Task] = None
+        #: in-flight outbound sends — retained so asyncio's weak task
+        #: reference can't collect a deal/response mid-RPC
+        self._send_tasks: Set[asyncio.Task] = set()
         self._lock = asyncio.Lock()
         #: per-phase wall-time accounting (deal verification is the
         #: slowest protocol phase — ROADMAP direction 3 batches it);
@@ -191,7 +194,9 @@ class DKGHandler:
             except Exception as exc:
                 log.debug("dkg send failed", to=peer.address, err=exc)
 
-        asyncio.create_task(_go())
+        t = asyncio.create_task(_go())
+        self._send_tasks.add(t)
+        t.add_done_callback(self._send_tasks.discard)
 
     # -- inbound ----------------------------------------------------------
 

@@ -30,7 +30,9 @@ class Identity:
     """A node's public identity: G1 key + reachable address (+ TLS)."""
 
     address: str
-    key: tuple  # affine G1 point
+    #: affine G1 point; None for address-only identities (the replica
+    #: ring forwards by address and never needs the peer's key)
+    key: Optional[tuple] = None
     tls: bool = False
 
     @property

@@ -30,6 +30,16 @@ def _source_digest(src: Path) -> str:
     return hashlib.sha256(src.read_bytes() + b"\0" + tag).hexdigest()[:16]
 
 
+def sanitize_enabled() -> bool:
+    """ASan+UBSan build mode (`DRAND_NATIVE_SAN=1`): the C++ backends
+    are rebuilt with -fsanitize=address,undefined so the native test
+    suites catch heap corruption / UB that a plain -O2 build silently
+    survives.  Loading such a .so into an uninstrumented python needs
+    libasan preloaded — `make test-native-san` (tools/native_san.py)
+    sets that up; flipping the env var alone will abort at dlopen."""
+    return os.environ.get("DRAND_NATIVE_SAN", "") not in ("", "0")
+
+
 def shared_lib(name: str) -> Optional[str]:
     """Path to the built shared library for `name`.cc, compiling if
     needed.  Returns None (and remembers why) if no compiler is usable —
@@ -37,7 +47,10 @@ def shared_lib(name: str) -> Optional[str]:
     global _BUILD_ERROR
     src = _SRC_DIR / f"{name}.cc"
     tag = _source_digest(src)
-    out = _BUILD_DIR / f"{name}-{tag}.so"
+    san = sanitize_enabled()
+    # sanitized artifacts live under a distinct name so a san run never
+    # poisons the production cache (and vice versa)
+    out = _BUILD_DIR / f"{name}-{tag}{'-san' if san else ''}.so"
     if out.exists():
         return str(out)
     with _LOCK:
@@ -49,9 +62,19 @@ def shared_lib(name: str) -> Optional[str]:
         # per-pid temp name: concurrent daemon processes may race to
         # build the same digest; os.replace makes the publish atomic
         tmp = out.with_suffix(f".so.{os.getpid()}.tmp")
+        if san:
+            flags = [
+                # -O1 keeps stack traces honest; recover=off turns every
+                # UB finding into a hard abort the test run can't miss
+                "-O1", "-g", "-fno-omit-frame-pointer",
+                "-fsanitize=address,undefined",
+                "-fno-sanitize-recover=undefined",
+            ]
+        else:
+            flags = ["-O2"]
         cmd = [
             os.environ.get("CXX", "g++"),
-            "-O2", "-std=c++17", "-shared", "-fPIC",
+            *flags, "-std=c++17", "-shared", "-fPIC",
             str(src), "-o", str(tmp),
         ]
         try:

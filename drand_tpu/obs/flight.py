@@ -28,6 +28,35 @@ from typing import List, Optional
 #: alone collides when several in-process nodes dump at once
 _TMP_SEQ = itertools.count()
 
+#: Canonical vocabulary of flight-event kinds.  `cli doctor`, the sim
+#: timeline lens and the watchdog tests all dispatch on these strings,
+#: so a typo at a `record(...)` call site silently drops the event from
+#: every consumer.  drand-lint's `reg-flight-event` rule resolves every
+#: literal kind in the tree against this set — add the kind here FIRST,
+#: then record it.
+EVENT_KINDS = frozenset({
+    # process lifecycle / incidents
+    "crash", "signal",
+    # tracer sink + kernel dispatches + gateway sheds
+    "span", "kernel", "shed",
+    # SLO engine and on-demand profiler
+    "slo_breach", "profile_start", "profile_done",
+    # performance observatory edge-triggered alarms (passed through
+    # PerfObservatory._edge's `kind` parameter)
+    "perf.dispatch_budget", "perf.recompile_storm",
+    # chain fork resolution
+    "chain.reorg", "chain.reorg_refused", "sync_starved",
+    # external chain watchdog
+    "watch_fork", "watch_reorg", "watch_stalled", "watch_resumed",
+    "watch_head_lag", "watch_catchup", "watch_caught_up",
+    "watch_bad_beacon", "watch_bad_chain",
+    "watch_peer_unreachable", "watch_peer_ok",
+    # simulation harness event log
+    "sim_start", "sim_end", "node_crash", "node_restart", "node_span",
+    "round_stored", "chain_reorg", "action_failed", "fault_event",
+    "invariant_check",
+})
+
 
 class FlightRecorder:
     """Fixed-capacity event ring; thread-safe, allocation-light.

@@ -42,13 +42,20 @@ import subprocess
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 from drand_tpu.obs import flight
 from drand_tpu.utils import metrics
 
 PERF_SCHEMA = "drand-tpu.perf.v1"
 LINEAGE_SCHEMA = "drand-tpu.lineage.v1"
+
+#: Closed degraded_reason vocabulary: is a degraded artifact the
+#: environment's fault or ours?  `lineage()` validates against it at
+#: construction and drand-lint's `reg-degraded-reason` rule holds every
+#: literal in the tree to it — a third value would otherwise slip past
+#: the bench-lineage coherence tests unvalidated.
+DEGRADED_REASONS = ("infra", "code")
 
 #: honest optimistic round budget: one fused partial-admit-free finalize
 #: dispatch + one sign dispatch (PR 5's invariant)
@@ -68,12 +75,12 @@ class _P2:
 
     __slots__ = ("p", "q", "n", "npos", "dn", "count")
 
-    def __init__(self, p: float):
+    def __init__(self, p: float) -> None:
         self.p = p
         self.q: List[float] = []            # marker heights
-        self.n = [0, 1, 2, 3, 4]            # marker positions (0-based)
-        self.npos = [0.0, 2 * p, 4 * p, 2 + 2 * p, 4.0]
-        self.dn = [0.0, p / 2, p, (1 + p) / 2, 1.0]
+        self.n: List[int] = [0, 1, 2, 3, 4]  # marker positions (0-based)
+        self.npos: List[float] = [0.0, 2 * p, 4 * p, 2 + 2 * p, 4.0]
+        self.dn: List[float] = [0.0, p / 2, p, (1 + p) / 2, 1.0]
         self.count = 0
 
     def observe(self, x: float) -> None:
@@ -135,13 +142,17 @@ class _P2:
         return len(self.q) + len(self.n) + len(self.npos)
 
 
+def _round6(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 6)
+
+
 class StreamingQuantiles:
     """p50/p95/p99 + count/min/max/mean over a stream, fixed memory."""
 
     __slots__ = ("_est", "count", "vmin", "vmax", "total", "last")
 
-    def __init__(self):
-        self._est = {p: _P2(p) for p in _QUANTILES}
+    def __init__(self) -> None:
+        self._est: Dict[float, _P2] = {p: _P2(p) for p in _QUANTILES}
         self.count = 0
         self.vmin: Optional[float] = None
         self.vmax: Optional[float] = None
@@ -167,19 +178,18 @@ class StreamingQuantiles:
         so the estimator provably stays fixed-memory."""
         return sum(est.marker_count() for est in self._est.values())
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> Dict[str, Any]:
         if self.count == 0:
             return {"count": 0}
-        r = lambda v: None if v is None else round(v, 6)  # noqa: E731
         return {
             "count": self.count,
-            "p50": r(self.quantile(0.5)),
-            "p95": r(self.quantile(0.95)),
-            "p99": r(self.quantile(0.99)),
-            "min": r(self.vmin),
-            "max": r(self.vmax),
-            "mean": r(self.total / self.count),
-            "last": r(self.last),
+            "p50": _round6(self.quantile(0.5)),
+            "p95": _round6(self.quantile(0.95)),
+            "p99": _round6(self.quantile(0.99)),
+            "min": _round6(self.vmin),
+            "max": _round6(self.vmax),
+            "mean": _round6(self.total / self.count),
+            "last": _round6(self.last),
         }
 
 
@@ -202,7 +212,7 @@ class PerfObservatory:
                  recompile_factor: float = 20.0,
                  recompile_min_seconds: float = 0.05,
                  storm_threshold: int = 3,
-                 storm_window: float = 60.0):
+                 storm_window: float = 60.0) -> None:
         self.budget = budget
         self.now_fn = now_fn
         self.recorder = recorder  # None -> the process flight recorder
@@ -215,8 +225,8 @@ class PerfObservatory:
         self._stages: Dict[str, StreamingQuantiles] = {}
         self._kernels: Dict[str, StreamingQuantiles] = {}
         self._breaching: Dict[str, bool] = {}
-        self._recompile_ts: deque = deque(maxlen=64)
-        self._rounds = {
+        self._recompile_ts: Deque[float] = deque(maxlen=64)
+        self._rounds: Dict[str, Any] = {
             "observed": 0, "honest": 0, "fallback": 0,
             "last_round": None, "last_dispatches": None,
             "exceeded_total": 0, "episodes": 0,
@@ -321,7 +331,7 @@ class PerfObservatory:
     # -- alarms ----------------------------------------------------------
 
     def _edge(self, alarm: str, active: bool, *, kind: str,
-              now: float, **fields) -> bool:
+              now: float, **fields: Any) -> bool:
         """Record a flight event only on alarm transitions; returns True
         when this call was a transition."""
         with self._lock:
@@ -346,12 +356,12 @@ class PerfObservatory:
 
     # -- views -----------------------------------------------------------
 
-    def snapshot(self, now: Optional[float] = None) -> dict:
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
         now = self.now_fn() if now is None else now
         with self._lock:
             storm = self._storm_active(now)
             recent = len(self._recompile_ts)
-            doc = {
+            doc: Dict[str, Any] = {
                 "schema": PERF_SCHEMA,
                 "time": now,
                 "stages": {name: est.snapshot()
@@ -400,7 +410,7 @@ reset = OBSERVATORY.reset
 _STAGE_PREFIXES = ("beacon.", "dkg.", "gateway.")
 
 
-def span_sink(span_dict: dict) -> None:
+def span_sink(span_dict: Dict[str, Any]) -> None:
     """Tracer sink: finished pipeline-stage spans become stage samples.
     Kernel spans are skipped — `obs.kernels` feeds the kernel registry
     directly (and still counts with tracing off)."""
@@ -437,17 +447,18 @@ def lineage(*, backend: Optional[str] = None,
             device: Optional[str] = None,
             degraded: bool = False,
             degraded_reason: Optional[str] = None,
-            extra: Optional[dict] = None) -> dict:
+            extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Provenance block stamped into every bench/loadgen artifact, so a
     committed number can always answer "measured where, on what, with
     which knobs, and did anything fall back"."""
-    if degraded_reason not in (None, "infra", "code"):
+    if degraded_reason is not None and \
+            degraded_reason not in DEGRADED_REASONS:
         raise ValueError(
             f"degraded_reason must be infra|code|None, got {degraded_reason!r}"
         )
     env = {k: v for k, v in sorted(os.environ.items())
            if k in _ENV_KEYS or k.startswith(_ENV_PREFIXES)}
-    doc = {
+    doc: Dict[str, Any] = {
         "schema": LINEAGE_SCHEMA,
         "git_rev": git_revision(),
         "python": platform.python_version(),
@@ -488,28 +499,30 @@ def classify_failure(text: str) -> str:
 _LOWER, _HIGHER, _DISPATCH = "latency", "throughput", "dispatch"
 
 
-def _num(v) -> Optional[float]:
+def _num(v: object) -> Optional[float]:
     return float(v) if isinstance(v, (int, float)) \
         and not isinstance(v, bool) else None
 
 
-def _put(out: dict, name: str, value, kind: str, unit: str = "") -> None:
+def _put(out: Dict[str, Dict[str, Any]], name: str, value: object,
+         kind: str, unit: str = "") -> None:
     num = _num(value)
     if num is not None:
         out[name] = {"value": num, "kind": kind, "unit": unit}
 
 
-def _pct_stages(out: dict, prefix: str, doc, kind: str = _LOWER) -> None:
+def _pct_stages(out: Dict[str, Dict[str, Any]], prefix: str,
+                doc: object, kind: str = _LOWER) -> None:
     if not isinstance(doc, dict):
         return
     for q in ("p50", "p95", "p99"):
         _put(out, f"{prefix}.{q}", doc.get(q), kind, "s")
 
 
-def extract_stages(doc: dict) -> Dict[str, dict]:
+def extract_stages(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     """Flatten any of the repo's artifact shapes (bench.py line,
     bench_suite payload, loadgen report) into comparable stage scalars."""
-    out: Dict[str, dict] = {}
+    out: Dict[str, Dict[str, Any]] = {}
     if not isinstance(doc, dict):
         return out
 
@@ -573,17 +586,22 @@ def extract_stages(doc: dict) -> Dict[str, dict]:
     return out
 
 
-def diff_stages(old: Dict[str, dict], new: Dict[str, dict],
-                tolerance: float = 0.25) -> List[dict]:
+def diff_stages(old: Dict[str, Dict[str, Any]],
+                new: Dict[str, Dict[str, Any]],
+                tolerance: float = 0.25) -> List[Dict[str, Any]]:
     """Stage-by-stage comparison.  Returns one row per stage seen in
     either artifact; `verdict` is ok|regression|improved|new|gone.
     Dispatch-count stages regress on ANY increase (tolerance ignored)."""
-    rows: List[dict] = []
+    rows: List[Dict[str, Any]] = []
     for name in sorted(set(old) | set(new)):
         o, n = old.get(name), new.get(name)
         if o is None or n is None:
-            rows.append({"stage": name, "kind": (o or n)["kind"],
-                         "old": o and o["value"], "new": n and n["value"],
+            present = o if o is not None else n
+            if present is None:    # unreachable: name came from old|new
+                continue
+            rows.append({"stage": name, "kind": present["kind"],
+                         "old": None if o is None else o["value"],
+                         "new": None if n is None else n["value"],
                          "delta_pct": None,
                          "verdict": "new" if o is None else "gone"})
             continue
@@ -607,7 +625,7 @@ def diff_stages(old: Dict[str, dict], new: Dict[str, dict],
     return rows
 
 
-def load_artifact(path: str) -> dict:
+def load_artifact(path: str) -> Dict[str, Any]:
     """Parse a bench/loadgen artifact file.  bench.py output may carry
     retry-marker lines before the final artifact; keep the LAST line
     that parses as a recognisable document."""
@@ -620,7 +638,7 @@ def load_artifact(path: str) -> dict:
             return doc
     except ValueError:
         pass
-    best = None
+    best: Optional[Dict[str, Any]] = None
     for line in text.splitlines():
         line = line.strip()
         if not line.startswith("{"):

@@ -91,13 +91,19 @@ _mesh_batches = metrics.counter(
     "drand_serve_mesh_batches_total",
     "flushes dispatched as one mesh-sharded pairing program",
 )
+#: Closed vocabulary of shed reasons.  The label value rides the
+#: drand_serve_shed_total series, the REST/gRPC error bodies and the
+#: fleet aggregator's pressure view; drand-lint's `reg-shed-reason`
+#: resolves every literal in the tree against this tuple.
+SHED_REASONS = ("queue_full", "deadline", "oversize", "client_quota")
+
 _shed = {
     reason: metrics.counter(
         "drand_serve_shed_total",
         "requests rejected instead of served late",
         labels={"reason": reason},
     )
-    for reason in ("queue_full", "deadline", "oversize", "client_quota")
+    for reason in SHED_REASONS
 }
 _requests = {
     result: metrics.counter(

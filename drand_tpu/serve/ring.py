@@ -29,7 +29,16 @@ from __future__ import annotations
 import bisect
 import hashlib
 import threading
-from typing import Awaitable, Callable, Dict, List, Optional, Sequence
+from typing import (
+    Any,
+    Awaitable,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Set,
+)
 
 from drand_tpu.utils import metrics
 from drand_tpu.utils.logging import get_logger
@@ -70,13 +79,14 @@ class HashRing:
     membership changes move only the joining/leaving replica's rounds.
     """
 
-    def __init__(self, replicas: Sequence[str] = (), vnodes: int = 64):
+    def __init__(self, replicas: Sequence[str] = (),
+                 vnodes: int = 64) -> None:
         if vnodes < 1:
             raise ValueError("vnodes must be >= 1")
         self._vnodes = vnodes
         self._hashes: List[int] = []     # sorted ring positions
         self._owners: List[str] = []     # owner at each position
-        self._members: set = set()
+        self._members: Set[str] = set()
         for r in replicas:
             self.add(r)
 
@@ -120,8 +130,10 @@ class HashRing:
 
 
 #: async forward(owner_id, req, timeout, client) -> serve.VerifyResult
-Forwarder = Callable[[str, object, Optional[float], Optional[str]],
-                     Awaitable[object]]
+#: (req/result stay Any: the ring is transport plumbing and must not
+#: import the gateway's request/result types — that would be a cycle)
+Forwarder = Callable[[str, Any, Optional[float], Optional[str]],
+                     Awaitable[Any]]
 
 
 class ReplicaRing:
@@ -136,7 +148,7 @@ class ReplicaRing:
 
     def __init__(self, self_id: str, peers: Sequence[str] = (), *,
                  forward: Optional[Forwarder] = None, vnodes: int = 64,
-                 fail_evict: int = 3):
+                 fail_evict: int = 3) -> None:
         if fail_evict < 1:
             raise ValueError("fail_evict must be >= 1")
         self.self_id = self_id
@@ -167,7 +179,9 @@ class ReplicaRing:
     def can_forward(self) -> bool:
         return self._forward is not None
 
-    async def forward(self, owner: str, req, timeout, client):
+    async def forward(self, owner: str, req: Any,
+                      timeout: Optional[float],
+                      client: Optional[str]) -> Any:
         """One forward attempt to `owner`; raises whatever the transport
         or the remote gateway raises.  Callers decide the fallback."""
         if self._forward is None:
@@ -200,7 +214,7 @@ class ReplicaRing:
         self.local_fallbacks += 1
         _local_fallback.inc()
 
-    def stats(self) -> dict:
+    def stats(self) -> Dict[str, Any]:
         """Ring topology + forwarding counters for /v1/status."""
         return {
             "self": self.self_id,
@@ -212,13 +226,14 @@ class ReplicaRing:
         }
 
 
-def inprocess_forwarder(replicas: Dict[str, object]) -> Forwarder:
+def inprocess_forwarder(replicas: Dict[str, Any]) -> Forwarder:
     """Forward by direct await on a sibling gateway in this process —
     the loadgen / chaos-scenario transport.  `replicas` maps replica id
     -> VerifyGateway (a closed gateway raises GatewayClosed like a dead
     network peer would)."""
 
-    async def forward(owner, req, timeout, client):
+    async def forward(owner: str, req: Any, timeout: Optional[float],
+                      client: Optional[str]) -> Any:
         import dataclasses
 
         from drand_tpu.serve import gateway as gw_mod
@@ -232,13 +247,14 @@ def inprocess_forwarder(replicas: Dict[str, object]) -> Forwarder:
     return forward
 
 
-def grpc_forwarder(client, *, tls: bool = False) -> Forwarder:
+def grpc_forwarder(client: Any, *, tls: bool = False) -> Forwarder:
     """Forward over the existing gRPC public API (`VerifyBeacon`),
     mapping the peer's explicit shed codes back onto GatewayErrors so
     the caller can tell "owner alive but shedding" (serve locally, no
     eviction strike) from "owner unreachable" (strike)."""
 
-    async def forward(owner, req, timeout, fwd_client):
+    async def forward(owner: str, req: Any, timeout: Optional[float],
+                      fwd_client: Optional[str]) -> Any:
         import grpc
 
         from drand_tpu.key.keys import Identity

@@ -16,12 +16,66 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple, cast
 
 _BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
     0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
+
+#: Canonical name registry for every drand_* series the tree emits.
+#: deploy/prometheus-alerts.yml and deploy/grafana-dashboard.json match
+#: these strings with PromQL regexes the interpreter never sees — a
+#: rename at a call site silently rots the alert.  drand-lint's
+#: `reg-metric-name` rule resolves every literal registration against
+#: this set (and `reg-deploy-metric` checks the deploy files the other
+#: way), so renames fail CI instead: add/rename the name here FIRST.
+METRIC_NAMES = frozenset({
+    # beacon protocol plane
+    "drand_beacon_rounds_total", "drand_beacon_rounds_failed_total",
+    "drand_beacon_partials_received_total",
+    "drand_beacon_partials_rejected_total",
+    "drand_beacon_sync_rounds_verified_total",
+    "drand_beacon_optimistic_fallbacks_total",
+    "drand_beacon_round_seconds", "drand_beacon_head_round",
+    "drand_chain_reorgs_total", "drand_sync_failures_total",
+    # crypto / device plane
+    "drand_device_kernel_seconds", "drand_dkg_phase_seconds",
+    # verification gateway + replica ring
+    "drand_serve_queue_depth", "drand_serve_batch_size",
+    "drand_serve_batch_seconds", "drand_serve_cache_hits_total",
+    "drand_serve_coalesced_total", "drand_serve_device_occupancy",
+    "drand_serve_mesh_batches_total", "drand_serve_shed_total",
+    "drand_serve_requests_total", "drand_serve_client_requests_total",
+    "drand_serve_ring_forwarded_total",
+    "drand_serve_ring_forward_failures_total",
+    "drand_serve_ring_local_fallback_total",
+    "drand_serve_ring_evicted_total",
+    # SLO engine
+    "drand_slo_events_total", "drand_slo_breaches_total",
+    "drand_slo_burn_rate", "drand_slo_error_budget_remaining",
+    # per-signer contribution ledger
+    "drand_peer_partial_latency_seconds",
+    "drand_peer_invalid_partials_total",
+    "drand_peer_orphaned_beacons_total",
+    "drand_peer_missed_rounds_total", "drand_peer_late_partials_total",
+    # external chain watchdog
+    "drand_watch_polls_total", "drand_watch_verified_rounds_total",
+    "drand_watch_bad_beacons_total", "drand_watch_forks_total",
+    "drand_watch_reorgs_total", "drand_watch_fork_detected",
+    "drand_watch_stalled", "drand_watch_head_round",
+    "drand_watch_peer_head_round", "drand_watch_peer_head_lag",
+    # fleet aggregation
+    "drand_fleet_head_spread", "drand_fleet_quorum_margin",
+    "drand_fleet_worst_burn_rate", "drand_fleet_nodes_reachable",
+    "drand_fleet_worst_stage_p99_seconds",
+    "drand_fleet_dispatch_budget_breaching",
+    # performance observatory
+    "drand_perf_stage_p99_seconds", "drand_perf_round_dispatches",
+    "drand_perf_dispatch_budget_exceeded_total",
+    "drand_perf_dispatch_budget_episodes_total",
+    "drand_perf_recompiles_suspected_total",
+})
 
 
 def _escape_label_value(v: str) -> str:
@@ -40,7 +94,7 @@ def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
 
 
 class Counter:
-    def __init__(self):
+    def __init__(self) -> None:
         self._v = 0.0
         self._lock = threading.Lock()
 
@@ -54,7 +108,7 @@ class Counter:
 
 
 class Gauge:
-    def __init__(self):
+    def __init__(self) -> None:
         self._v = 0.0
         self._lock = threading.Lock()
 
@@ -76,7 +130,7 @@ class Gauge:
 
 
 class Histogram:
-    def __init__(self, buckets: Tuple[float, ...] = _BUCKETS):
+    def __init__(self, buckets: Tuple[float, ...] = _BUCKETS) -> None:
         self._buckets = buckets
         self._counts = [0] * (len(buckets) + 1)
         self._sum = 0.0
@@ -106,65 +160,62 @@ class Histogram:
 
 
 class _Timer:
-    def __init__(self, h: Histogram):
+    def __init__(self, h: Histogram) -> None:
         self._h = h
 
-    def __enter__(self):
+    def __enter__(self) -> "_Timer":
         self._t0 = time.perf_counter()
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: object) -> bool:
         self._h.observe(time.perf_counter() - self._t0)
         return False
 
 
+_KIND_NAMES: Dict[type, str] = {
+    Counter: "counter",
+    Gauge: "gauge",
+    Histogram: "histogram",
+}
+
+_LabelKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
 class Registry:
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._metrics: Dict[
-            Tuple[str, Tuple[Tuple[str, str], ...]], object
-        ] = {}
+        self._metrics: Dict[_LabelKey, object] = {}
         self._help: Dict[str, Tuple[str, str]] = {}  # name -> (type, help)
 
-    def _get(self, kind, name: str, help: str, labels: Optional[dict],
-             **kwargs):
+    def _get(self, kind: type, name: str, help: str,
+             labels: Optional[Dict[str, str]], **kwargs: Any) -> object:
         key = (name, tuple(sorted((labels or {}).items())))
         with self._lock:
             m = self._metrics.get(key)
             if m is None:
                 m = kind(**kwargs)
                 self._metrics[key] = m
-                self._help.setdefault(
-                    name,
-                    (
-                        {
-                            Counter: "counter",
-                            Gauge: "gauge",
-                            Histogram: "histogram",
-                        }[kind],
-                        help,
-                    ),
-                )
+                self._help.setdefault(name, (_KIND_NAMES[kind], help))
             return m
 
     def counter(self, name: str, help: str = "",
-                labels: Optional[dict] = None) -> Counter:
-        return self._get(Counter, name, help, labels)
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return cast(Counter, self._get(Counter, name, help, labels))
 
     def gauge(self, name: str, help: str = "",
-              labels: Optional[dict] = None) -> Gauge:
-        return self._get(Gauge, name, help, labels)
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return cast(Gauge, self._get(Gauge, name, help, labels))
 
     def histogram(self, name: str, help: str = "",
-                  labels: Optional[dict] = None,
+                  labels: Optional[Dict[str, str]] = None,
                   buckets: Optional[Tuple[float, ...]] = None) -> Histogram:
         """`buckets` overrides the latency-oriented defaults (used for
         size-shaped distributions like batch occupancy); it only applies
         on first registration of a (name, labels) series."""
         if buckets is not None:
-            return self._get(Histogram, name, help, labels,
-                             buckets=buckets)
-        return self._get(Histogram, name, help, labels)
+            return cast(Histogram, self._get(Histogram, name, help,
+                                             labels, buckets=buckets))
+        return cast(Histogram, self._get(Histogram, name, help, labels))
 
     def render(self) -> str:
         """Prometheus text exposition format."""
@@ -172,7 +223,7 @@ class Registry:
             items = sorted(self._metrics.items())
             helps = dict(self._help)
         lines: List[str] = []
-        seen_header = set()
+        seen_header: Set[str] = set()
         for (name, labels), m in items:
             if name not in seen_header:
                 typ, help = helps.get(name, ("untyped", ""))

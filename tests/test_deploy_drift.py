@@ -1,0 +1,76 @@
+"""Deploy artifacts must not drift from the code's metric registry.
+
+deploy/prometheus-alerts.yml and deploy/grafana-dashboard.json match
+metric series with PromQL strings the interpreter never evaluates — a
+rename at a registration site rots the alert silently.  drand-lint's
+`reg-deploy-metric` rule enforces this statically from the AST; this
+test enforces the same invariant at runtime from the *imported* registry
+(drand_tpu.utils.metrics.METRIC_NAMES), so the two catch each other:
+the linter cross-checks literals the import path never executes, and
+this test survives even if someone bypasses the linter.
+"""
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+from drand_tpu.utils.metrics import METRIC_NAMES
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+ALERTS = REPO_ROOT / "deploy" / "prometheus-alerts.yml"
+DASHBOARD = REPO_ROOT / "deploy" / "grafana-dashboard.json"
+
+_TOKEN_RE = re.compile(r"\bdrand_[a-z0-9_]+\b")
+#: series Prometheus derives from one histogram registration
+_HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+#: drand_* tokens in deploy files that are not metric names
+_ALLOWLIST = {"drand_tpu"}
+
+
+def _resolves(token: str) -> bool:
+    if token in METRIC_NAMES or token in _ALLOWLIST:
+        return True
+    return any(
+        token.endswith(suf) and token[: -len(suf)] in METRIC_NAMES
+        for suf in _HISTO_SUFFIXES
+    )
+
+
+def _unresolved(path: Path):
+    bad = []
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        for tok in _TOKEN_RE.findall(line):
+            if not _resolves(tok):
+                bad.append(f"{path.name}:{i}: {tok}")
+    return bad
+
+
+def test_alert_rules_reference_only_registered_metrics():
+    assert _unresolved(ALERTS) == []
+
+
+def test_dashboard_references_only_registered_metrics():
+    assert _unresolved(DASHBOARD) == []
+
+
+def test_deploy_files_are_not_vacuous():
+    # the cross-check only means something if the artifacts actually
+    # pivot on our metrics
+    assert len(_TOKEN_RE.findall(ALERTS.read_text())) > 5
+    assert len(_TOKEN_RE.findall(DASHBOARD.read_text())) > 5
+
+
+def test_dashboard_is_valid_json():
+    doc = json.loads(DASHBOARD.read_text())
+    assert isinstance(doc, dict)
+
+
+@pytest.mark.parametrize("name", sorted(METRIC_NAMES))
+def test_registry_names_are_well_formed(name):
+    assert re.fullmatch(r"drand_[a-z0-9_]+", name), name
+    # Prometheus histogram suffixes are reserved: a base name ending in
+    # one would collide with its own derived series
+    assert not name.endswith(_HISTO_SUFFIXES), name

@@ -1,0 +1,569 @@
+"""drand-lint unit tests.
+
+Every rule gets a violating AND a compliant fixture; on top of that the
+suppression syntax, the baseline ratchet and the CLI are exercised, and
+one test proves the CI failure mode end-to-end by running
+``python -m tools.drandlint --baseline`` against a fixture tree with a
+seeded violation and asserting exit code 1.
+
+Fixture trees are built under tmp_path with the same ``drand_tpu/``
+package layout as the real repository — the linter never imports the
+code it checks (registries are extracted from the scanned AST), so these
+throwaway trees exercise exactly the code path CI runs on the real tree.
+"""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.drandlint import engine
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+#: canonical registries for the drift-pack fixtures (the scan picks
+#: these up from the fixture's own AST, location within the tree is
+#: irrelevant)
+REGISTRIES = """
+EVENT_KINDS = frozenset({"round_published", "shed"})
+METRIC_NAMES = frozenset({"drand_rounds_total", "drand_lat_seconds"})
+SHED_REASONS = frozenset({"queue_full"})
+DEGRADED_REASONS = frozenset({"infra", "code"})
+"""
+
+
+def mktree(root: Path, files: dict) -> Path:
+    for rel, text in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return root
+
+
+def lint(root: Path, **kw) -> engine.Report:
+    return engine.run_lint(root, **kw)
+
+
+def hits(report: engine.Report, rule: str):
+    return [v for v in report.active if v.rule == rule]
+
+
+# -- hot-path purity (hp-*) ----------------------------------------------
+
+class TestHotPath:
+    def test_raw_sync_flagged(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/beacon/x.py": """\
+            def publish(sig):
+                return sig.block_until_ready()
+            """})
+        vs = hits(lint(root), "hp-sync-call")
+        assert len(vs) == 1
+        assert vs[0].path == "drand_tpu/beacon/x.py"
+        assert vs[0].line == 2
+        assert "block_until_ready" in vs[0].message
+
+    def test_raw_sync_allowed_in_kernels(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/obs/kernels.py": """\
+            def block(x):
+                return x.block_until_ready()
+            """})
+        assert lint(root).active == []
+
+    def test_raw_sync_outside_package_ignored(self, tmp_path):
+        root = mktree(tmp_path, {"bench/pull.py": """\
+            def pull(x):
+                return x.device_get()
+            """})
+        assert lint(root, paths=[root]).active == []
+
+    def test_untimed_sync_flagged(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/beacon/y.py": """\
+            import jax
+            import numpy as np
+
+            def pull(f, x):
+                a = float(f(x))
+                b = np.asarray(f(x))
+                return a, b
+            """})
+        vs = hits(lint(root), "hp-untimed-sync")
+        assert [v.line for v in vs] == [5, 6]
+
+    def test_untimed_sync_inside_kernel_span_ok(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/beacon/y.py": """\
+            import jax
+            from drand_tpu.obs.kernels import kernel_span
+
+            def pull(f, x):
+                with kernel_span("pull"):
+                    return float(f(x))
+            """})
+        assert lint(root).active == []
+
+    def test_untimed_sync_needs_jax_import(self, tmp_path):
+        # float(call()) in a jax-free file is ordinary python
+        root = mktree(tmp_path, {"drand_tpu/utils/num.py": """\
+            def parse(s):
+                return float(s.strip())
+            """})
+        assert lint(root).active == []
+
+    def test_untimed_sync_ops_exempt(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/ops/stage.py": """\
+            import jax
+
+            def to_host(f, x):
+                return float(f(x))
+            """})
+        assert lint(root).active == []
+
+    def test_jit_scope_flagged(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/beacon/z.py": """\
+            import jax
+
+            def make():
+                return jax.jit(lambda x: x + 1)
+            """})
+        vs = hits(lint(root), "hp-jit-scope")
+        assert len(vs) == 1 and vs[0].line == 4
+
+    def test_jit_allowed_in_kernel_layers(self, tmp_path):
+        body = "import jax\n\nf = jax.jit(abs)\n"
+        root = mktree(tmp_path, {
+            "drand_tpu/ops/k.py": body,
+            "drand_tpu/parallel/p.py": body,
+            "drand_tpu/crypto/tbls.py": body,
+        })
+        assert lint(root).active == []
+
+
+# -- sim determinism (sim-*) ---------------------------------------------
+
+class TestSimDet:
+    def test_wallclock_flagged(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/sim/fabric.py": """\
+            import time
+
+            def now():
+                return time.time()
+            """})
+        vs = hits(lint(root), "sim-wallclock")
+        assert len(vs) == 1 and "time.time" in vs[0].message
+
+    def test_wallclock_outside_sim_ok(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/utils/clock.py": """\
+            import time
+
+            def now():
+                return time.time()
+            """})
+        assert lint(root).active == []
+
+    def test_entropy_flagged(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/sim/chaos.py": """\
+            import os
+            import random
+
+            def draw():
+                a = os.urandom(8)
+                b = random.random()
+                c = np.random.normal()
+                return a, b, c
+            """})
+        vs = hits(lint(root), "sim-entropy")
+        assert len(vs) == 3
+
+    def test_seeded_stream_ok(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/sim/chaos.py": """\
+            import random
+
+            def stream(seed):
+                rng = random.Random(seed)
+                return rng.random()
+            """})
+        assert lint(root).active == []
+
+
+# -- asyncio discipline (aio-*) ------------------------------------------
+
+class TestAsyncio:
+    def test_lock_await_flagged(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/beacon/h.py": """\
+            class Handler:
+                async def publish(self, pkt):
+                    async with self._lock:
+                        await self._net.send(pkt)
+            """})
+        vs = hits(lint(root), "aio-lock-await")
+        assert len(vs) == 1 and "self._lock" in vs[0].message
+
+    def test_semaphore_await_ok(self, tmp_path):
+        # semaphores bound concurrency by design (the gossip sender)
+        root = mktree(tmp_path, {"drand_tpu/beacon/h.py": """\
+            class Handler:
+                async def publish(self, pkt):
+                    async with self._sem:
+                        await self._net.send(pkt)
+            """})
+        assert lint(root).active == []
+
+    def test_snapshot_then_await_ok(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/beacon/h.py": """\
+            class Handler:
+                async def publish(self):
+                    async with self._lock:
+                        pkt = self._queue.pop()
+                    await self._net.send(pkt)
+            """})
+        assert lint(root).active == []
+
+    def test_blocking_call_flagged(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/core/d.py": """\
+            import time
+
+            async def settle():
+                time.sleep(0.1)
+                native_bls.verify(b"sig")
+            """})
+        vs = hits(lint(root), "aio-blocking-call")
+        assert [v.line for v in vs] == [4, 5]
+
+    def test_blocking_in_sync_def_ok(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/core/d.py": """\
+            import asyncio
+            import time
+
+            def warmup():
+                time.sleep(0.1)
+
+            async def settle():
+                await asyncio.sleep(0.1)
+            """})
+        assert lint(root).active == []
+
+    def test_orphan_task_flagged(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/core/d.py": """\
+            import asyncio
+
+            async def go():
+                pass
+
+            def kick(loop):
+                asyncio.create_task(go())
+                asyncio.ensure_future(go())
+                loop.create_task(go())
+            """})
+        vs = hits(lint(root), "aio-orphan-task")
+        assert [v.line for v in vs] == [7, 8, 9]
+
+    def test_retained_task_ok(self, tmp_path):
+        # the net/mux.py idiom: retain, discard on completion
+        root = mktree(tmp_path, {"drand_tpu/core/d.py": """\
+            import asyncio
+
+            tasks = set()
+
+            async def go():
+                pass
+
+            def kick():
+                t = asyncio.create_task(go())
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+            """})
+        assert lint(root).active == []
+
+    def test_swallow_cancel_flagged(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/beacon/h.py": """\
+            async def cleanup(fut):
+                try:
+                    await fut
+                except BaseException:
+                    pass
+
+            async def drain(fut):
+                try:
+                    await fut
+                except:
+                    pass
+            """})
+        vs = hits(lint(root), "aio-swallow-cancel")
+        assert [v.line for v in vs] == [4, 10]
+
+    def test_swallow_cancel_compliant_forms_ok(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/beacon/h.py": """\
+            import asyncio
+
+            async def cleanup(fut):
+                try:
+                    await fut
+                except (Exception, asyncio.CancelledError):
+                    pass
+
+            async def guard(fut):
+                try:
+                    await fut
+                except BaseException:
+                    note()
+                    raise
+            """})
+        assert lint(root).active == []
+
+
+# -- registry drift (reg-*) ----------------------------------------------
+
+class TestRegistry:
+    def test_flight_event_kind(self, tmp_path):
+        root = mktree(tmp_path, {
+            "drand_tpu/obs/flight.py": REGISTRIES,
+            "drand_tpu/beacon/h.py": """\
+            class Handler:
+                def ok(self):
+                    self._flight.record("round_published", round=1)
+
+                def typo(self):
+                    self._flight.record("round_publishd", round=1)
+            """})
+        vs = hits(lint(root), "reg-flight-event")
+        assert len(vs) == 1
+        assert "round_publishd" in vs[0].message and vs[0].line == 6
+
+    def test_metric_name(self, tmp_path):
+        root = mktree(tmp_path, {
+            "drand_tpu/obs/flight.py": REGISTRIES,
+            "drand_tpu/utils/m.py": """\
+            ok = counter("drand_rounds_total", "fine")
+            bad = counter("drand_typo_total", "unregistered")
+            other = counter("requests")  # non-drand_* namespaces ignored
+            """})
+        vs = hits(lint(root), "reg-metric-name")
+        assert len(vs) == 1 and "drand_typo_total" in vs[0].message
+
+    def test_shed_reason(self, tmp_path):
+        root = mktree(tmp_path, {
+            "drand_tpu/obs/flight.py": REGISTRIES,
+            "drand_tpu/serve/g.py": """\
+            class Gateway:
+                def shed(self, rec):
+                    rec.record("shed", reason="queue_full")
+                    rec.record("shed", reason="queue_fullz")
+                    self._shed["queue_full"] += 1
+                    self._shed["weird"] += 1
+            """})
+        vs = hits(lint(root), "reg-shed-reason")
+        assert sorted(v.line for v in vs) == [4, 6]
+
+    def test_degraded_reason(self, tmp_path):
+        root = mktree(tmp_path, {
+            "drand_tpu/obs/flight.py": REGISTRIES,
+            "drand_tpu/obs/p.py": """\
+            def lineage(doc):
+                a = make(degraded_reason="infra")
+                b = make(degraded_reason="meteor")
+                c = {"degraded_reason": "wat"}
+                if doc.get("degraded_reason") == "nope":
+                    pass
+                return a, b, c
+            """})
+        vs = hits(lint(root), "reg-degraded-reason")
+        assert sorted(v.line for v in vs) == [3, 4, 5]
+
+    def test_deploy_metric(self, tmp_path):
+        root = mktree(tmp_path, {
+            "drand_tpu/obs/flight.py": REGISTRIES,
+            "drand_tpu/utils/m.py": """\
+            rounds = counter("drand_rounds_total", "rounds")
+            lat = histogram("drand_lat_seconds", "latency")
+            """,
+            "deploy/prometheus-alerts.yml": """\
+            # drand_tpu alert rules
+            - alert: Stalled
+              expr: rate(drand_rounds_total[5m]) == 0
+            - alert: Slow
+              expr: histogram_quantile(0.99, drand_lat_seconds_bucket)
+            - alert: Rotten
+              expr: drand_gone_total > 0
+            """})
+        vs = hits(lint(root), "reg-deploy-metric")
+        # _bucket resolves to the histogram base name; the drand_tpu
+        # token rides the allowlist; only the stale name is flagged
+        assert len(vs) == 1
+        assert "drand_gone_total" in vs[0].message
+        assert vs[0].path == "deploy/prometheus-alerts.yml"
+
+    def test_deploy_skipped_when_tree_registers_nothing(self, tmp_path):
+        root = mktree(tmp_path, {
+            "drand_tpu/core/d.py": "x = 1\n",
+            "deploy/prometheus-alerts.yml": "expr: drand_gone_total\n",
+        })
+        assert hits(lint(root), "reg-deploy-metric") == []
+
+
+# -- suppression syntax ---------------------------------------------------
+
+SUPPRESSED_JIT = """\
+import jax
+
+def make():
+    return jax.jit(lambda x: x)  # drandlint: allow[hp-jit-scope] warmup audited here
+"""
+
+SUPPRESSED_JIT_OWN_LINE = """\
+import jax
+
+def make():
+    # drandlint: allow[hp-jit-scope] warmup audited here
+    return jax.jit(lambda x: x)
+"""
+
+
+class TestSuppression:
+    @pytest.mark.parametrize("body", [SUPPRESSED_JIT,
+                                      SUPPRESSED_JIT_OWN_LINE])
+    def test_allow_suppresses(self, tmp_path, body):
+        root = mktree(tmp_path, {"drand_tpu/beacon/z.py": body})
+        report = lint(root)
+        assert report.active == []
+        assert [v.rule for v in report.suppressed] == ["hp-jit-scope"]
+        assert report.suppressed[0].suppress_reason == \
+            "warmup audited here"
+
+    def test_allow_without_reason_is_itself_a_violation(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/beacon/z.py": """\
+            import jax
+
+            def make():
+                return jax.jit(lambda x: x)  # drandlint: allow[hp-jit-scope]
+            """})
+        report = lint(root)
+        # a reasonless allow suppresses nothing and is flagged itself
+        assert sorted(v.rule for v in report.active) == \
+            ["hp-jit-scope", "lint-suppression"]
+
+    def test_unknown_rule_id_flagged(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/core/d.py": """\
+            # drandlint: allow[hp-made-up] whatever
+            x = 1
+            """})
+        vs = hits(lint(root), "lint-suppression")
+        assert len(vs) == 1 and "hp-made-up" in vs[0].message
+
+    def test_parse_error_flagged(self, tmp_path):
+        root = mktree(tmp_path, {
+            "drand_tpu/core/broken.py": "def broken(:\n"})
+        vs = hits(lint(root), "lint-parse-error")
+        assert len(vs) == 1
+
+
+# -- baseline ratchet -----------------------------------------------------
+
+class TestBaseline:
+    def _bad_report(self, tmp_path):
+        root = mktree(tmp_path, {
+            "drand_tpu/beacon/z.py": "import jax\nf = jax.jit(abs)\n"})
+        return lint(root)
+
+    def test_ratchet_blocks_increase(self, tmp_path):
+        ok, msgs = engine.compare_baseline(self._bad_report(tmp_path), {})
+        assert not ok
+        assert any("hp-jit-scope" in m for m in msgs)
+
+    def test_ratchet_ok_at_or_below_baseline(self, tmp_path):
+        report = self._bad_report(tmp_path)
+        ok, msgs = engine.compare_baseline(report, {"hp-jit-scope": 1})
+        assert ok and msgs == []
+        ok, msgs = engine.compare_baseline(report, {"hp-jit-scope": 5})
+        assert ok  # improved: ratchet passes...
+        assert any("tighten" in m for m in msgs)  # ...and nags to tighten
+
+    def test_write_load_roundtrip(self, tmp_path):
+        report = self._bad_report(tmp_path)
+        bl = tmp_path / "baseline.json"
+        engine.write_baseline(bl, report)
+        assert engine.load_baseline(bl) == {"hp-jit-scope": 1}
+
+    def test_load_rejects_unknown_schema(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        bl.write_text('{"schema": "somebody-elses", "counts": {}}')
+        with pytest.raises(ValueError):
+            engine.load_baseline(bl)
+
+    def test_suppressed_violations_do_not_count(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/beacon/z.py": SUPPRESSED_JIT})
+        report = lint(root)
+        assert report.counts() == {}
+        assert report.counts(suppressed=True) == {"hp-jit-scope": 1}
+
+
+# -- CLI + the seeded-violation CI proof ----------------------------------
+
+def run_cli(*argv: str):
+    # cwd must be the repo checkout so `tools` is importable, exactly
+    # like the CI lint job runs it
+    return subprocess.run(
+        [sys.executable, "-m", "tools.drandlint", *argv],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+
+
+class TestCLI:
+    def test_ci_fails_on_seeded_violation(self, tmp_path):
+        """The acceptance proof: the exact command the CI lint job runs
+        exits non-zero against a tree with a seeded violation."""
+        root = mktree(tmp_path, {
+            "drand_tpu/beacon/z.py": "import jax\nf = jax.jit(abs)\n"})
+        bl = root / ".drandlint-baseline.json"
+        bl.write_text('{"schema": "drand-tpu.lint-baseline.v1", '
+                      '"counts": {}}\n')
+        proc = run_cli("--root", str(root),
+                       "--baseline", ".drandlint-baseline.json")
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "EXCEEDED" in proc.stdout
+        assert "hp-jit-scope" in proc.stdout
+
+    def test_clean_tree_passes_baseline(self, tmp_path):
+        root = mktree(tmp_path, {"drand_tpu/core/d.py": "x = 1\n"})
+        bl = root / ".drandlint-baseline.json"
+        bl.write_text('{"schema": "drand-tpu.lint-baseline.v1", '
+                      '"counts": {}}\n')
+        proc = run_cli("--root", str(root),
+                       "--baseline", ".drandlint-baseline.json")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "baseline OK" in proc.stdout
+
+    def test_plain_run_prints_findings(self, tmp_path):
+        root = mktree(tmp_path, {
+            "drand_tpu/sim/f.py": "import time\nt = time.time()\n"})
+        proc = run_cli("--root", str(root))
+        assert proc.returncode == 1
+        assert "drand_tpu/sim/f.py:2" in proc.stdout
+        assert "sim-wallclock" in proc.stdout
+
+    def test_list_rules_catalog_is_complete(self):
+        proc = run_cli("--list-rules")
+        assert proc.returncode == 0
+        for rule in ("hp-sync-call", "hp-untimed-sync", "hp-jit-scope",
+                     "sim-wallclock", "sim-entropy", "aio-lock-await",
+                     "aio-blocking-call", "aio-orphan-task",
+                     "aio-swallow-cancel", "reg-flight-event",
+                     "reg-metric-name", "reg-shed-reason",
+                     "reg-degraded-reason", "reg-deploy-metric",
+                     "lint-suppression", "lint-parse-error"):
+            assert rule in proc.stdout, f"missing rule {rule}"
+
+
+# -- the real tree --------------------------------------------------------
+
+class TestRepoClean:
+    def test_repo_is_lint_clean(self):
+        """The tree must be clean with NO baseline debt: deleting
+        .drandlint-baseline.json may never reveal hidden violations."""
+        report = engine.run_lint(REPO_ROOT)
+        assert report.active == [], \
+            "\n" + engine.render_text(report)
+
+    def test_committed_baseline_is_zero(self):
+        bl = engine.load_baseline(REPO_ROOT / ".drandlint-baseline.json")
+        assert bl == {}

@@ -1,0 +1,158 @@
+"""Regression tests for the fire-and-forget task bugs drand-lint found.
+
+asyncio holds only a weak reference to running tasks: a spawn whose
+handle is dropped can be garbage-collected mid-flight and its exception
+silently lost (the asyncio docs warn about exactly this).  The first
+`drandlint` run flagged four such spawns — beacon gossip sends, the
+daemon's partial-ingest path, the CLI signal handler's stop(), and DKG
+outbound sends — plus one CancelledError-swallowing `except
+BaseException` in the sync loop.  These tests pin the fixed behaviour:
+spawned work is retained while in flight, discarded on completion, and
+cancelled at shutdown.
+"""
+
+import asyncio
+import socket
+
+import pytest
+
+from test_beacon import build_network
+
+from drand_tpu.core import Config, Drand
+from drand_tpu.dkg.handler import DKGHandler
+from drand_tpu.key import Pair
+from drand_tpu.utils.clock import FakeClock
+
+
+# ---------------------------------------------------------- beacon gossip
+
+
+@pytest.mark.asyncio
+async def test_gossip_tasks_retained_and_discarded():
+    clock = FakeClock()
+    _, handlers, _, _ = build_network(3, 2, clock)
+    h = handlers[0]
+
+    gate = asyncio.Event()
+    sent = []
+
+    async def fake_send(node, packet):
+        sent.append(node.address)
+        await gate.wait()
+
+    h._send_packet = fake_send
+    peer = h.group.nodes[1]
+    task = h._spawn_gossip(peer, packet=None)
+
+    # in flight: the handler holds a strong reference
+    await asyncio.sleep(0)
+    assert task in h._gossip_tasks
+    assert sent == [peer.address]
+
+    # completed: the done-callback discards it
+    gate.set()
+    await task
+    await asyncio.sleep(0)
+    assert task not in h._gossip_tasks
+
+
+@pytest.mark.asyncio
+async def test_stop_cancels_inflight_gossip():
+    clock = FakeClock()
+    _, handlers, _, _ = build_network(3, 2, clock)
+    h = handlers[0]
+
+    async def hang(node, packet):
+        await asyncio.Event().wait()
+
+    h._send_packet = hang
+    tasks = [h._spawn_gossip(n, packet=None) for n in h.group.nodes[1:]]
+    await asyncio.sleep(0)
+    assert len(h._gossip_tasks) == 2
+
+    await h.stop()
+    await asyncio.sleep(0)
+    assert all(t.cancelled() for t in tasks)
+    assert not h._gossip_tasks
+
+
+# ------------------------------------------------------------- DKG sends
+
+
+class _GatedNet:
+    def __init__(self):
+        self.gate = asyncio.Event()
+        self.calls = 0
+
+    async def send_dkg(self, peer, packet):
+        self.calls += 1
+        await self.gate.wait()
+
+
+@pytest.mark.asyncio
+async def test_dkg_send_tasks_retained_until_done():
+    # _send only touches self.net and the module logger, so a bare
+    # instance isolates the retention mechanics from DKG setup
+    h = object.__new__(DKGHandler)
+    h._send_tasks = set()
+    h.net = _GatedNet()
+
+    await h._send(peer=None, packet={"phase": "deal"})
+    await asyncio.sleep(0)
+    assert len(h._send_tasks) == 1
+    assert h.net.calls == 1
+
+    h.net.gate.set()
+    await asyncio.gather(*h._send_tasks)
+    await asyncio.sleep(0)
+    assert not h._send_tasks
+
+
+# ----------------------------------------------------------- daemon spawn
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _one_daemon(clock):
+    addr = f"127.0.0.1:{_free_port()}"
+    pair = Pair.generate(addr)
+    cfg = Config(
+        listen_addr=addr,
+        control_port=_free_port(),
+        clock=clock,
+        in_memory=True,
+    )
+    return await Drand.new(cfg, pair)
+
+
+@pytest.mark.asyncio
+async def test_daemon_stop_cancels_spawned_work():
+    d = await _one_daemon(FakeClock())
+    try:
+        hung = d._spawn(asyncio.Event().wait())
+        await asyncio.sleep(0)
+        assert hung in d._bg_tasks
+    finally:
+        await d.stop()
+    await asyncio.sleep(0)
+    assert hung.cancelled()
+    assert hung not in d._bg_tasks
+
+
+@pytest.mark.asyncio
+async def test_request_shutdown_retains_stop_task():
+    # the CLI signal handler goes through request_shutdown, which must
+    # keep the stop() task alive (the old ensure_future dropped the only
+    # reference) and must not cancel itself mid-teardown
+    d = await _one_daemon(FakeClock())
+    d.request_shutdown()
+    assert d._bg_tasks, "stop task was not retained"
+    await asyncio.wait_for(d.wait_exit(), 30)
+    await asyncio.sleep(0)
+    assert not d._bg_tasks

@@ -1,0 +1,34 @@
+"""drand-lint: project-invariant static analysis for the drand_tpu tree.
+
+The reference drand is Go and gets `go vet`, the race detector and the
+compiler for free; this Python/asyncio/JAX port re-discovered the same
+invariant classes by hand across five PRs (dispatch budget, sim replay
+determinism, two asyncio liveness races).  drand-lint turns those
+conventions into machine-checked rules:
+
+* **hot-path purity** (`hp-*`) — device syncs only through the timed
+  `kernel_span` idiom, `jax.jit` only in the kernel layers;
+* **sim determinism** (`sim-*`) — no wall clock or ambient entropy
+  inside `drand_tpu/sim/`;
+* **asyncio discipline** (`aio-*`) — no slow awaits under a lock, no
+  blocking calls on the event loop, no orphaned tasks, no handlers that
+  can swallow cancellation;
+* **registry drift** (`reg-*`) — flight-event kinds, metric names, shed
+  reasons and `degraded_reason` literals resolve against their single
+  source of truth, and the deploy dashboards/alerts reference only
+  metrics the code actually emits.
+
+Dependency-free (stdlib `ast` only).  Run as ``python -m tools.drandlint``
+or ``python -m drand_tpu.cli lint``.  Violations are suppressed inline
+with ``# drandlint: allow[rule-id] <reason>`` and ratcheted by a
+committed baseline whose counts may only decrease.
+"""
+
+from tools.drandlint.engine import (  # noqa: F401
+    ALL_RULES,
+    LintConfig,
+    Report,
+    Violation,
+    compare_baseline,
+    run_lint,
+)

@@ -1,0 +1,95 @@
+"""``python -m tools.drandlint`` — run the suite from a repo checkout.
+
+Exit codes: 0 clean (or within baseline), 1 violations, 2 bad input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.drandlint import engine
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="drandlint",
+        description="project-invariant static analysis for drand_tpu",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint "
+                        "(default: <root>/drand_tpu)")
+    p.add_argument("--root", default=".",
+                   help="repository root all paths and conventions are "
+                        "relative to (default: cwd)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--baseline", metavar="FILE",
+                   help="ratchet file: per-rule violation counts may "
+                        "only decrease relative to it")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite --baseline with the current counts "
+                        "(tightening the ratchet)")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print suppressed violations")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for row in engine.rule_catalog():
+            print(f"{row['id']:22s} [{row['pack']}] {row['rationale']}")
+        return 0
+    root = Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"drand-lint: root {root} is not a directory",
+              file=sys.stderr)
+        return 2
+    paths = [Path(p) if Path(p).is_absolute() else root / p
+             for p in args.paths] or None
+    report = engine.run_lint(root, paths)
+
+    if args.baseline:
+        bpath = Path(args.baseline)
+        if not bpath.is_absolute():
+            bpath = root / bpath
+        if args.write_baseline:
+            engine.write_baseline(bpath, report)
+            print(f"drand-lint: wrote baseline {bpath} "
+                  f"({len(report.active)} violation(s))")
+            return 0
+        try:
+            baseline = engine.load_baseline(bpath)
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            print(f"drand-lint: cannot read baseline {bpath}: {exc}",
+                  file=sys.stderr)
+            return 2
+        ok, msgs = engine.compare_baseline(report, baseline)
+        if args.as_json:
+            doc = report.to_dict()
+            doc["baseline"] = {"path": str(bpath), "ok": ok,
+                               "messages": msgs}
+            print(json.dumps(doc, indent=2))
+        else:
+            if not ok:
+                print(engine.render_text(report, args.show_suppressed))
+            for m in msgs:
+                print(f"drand-lint: {m}")
+            print(f"drand-lint: baseline "
+                  f"{'OK' if ok else 'EXCEEDED'} ({bpath.name})")
+        return 0 if ok else 1
+
+    if args.as_json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(engine.render_text(report, args.show_suppressed))
+    return 0 if not report.active else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,483 @@
+"""Core of drand-lint: source model, suppression syntax, rule protocol,
+baseline ratchet and report rendering.
+
+Everything here is deliberately boring: plain `ast` walks over a list of
+`Source` objects, a `Project` that lazily extracts the canonical name
+registries (EVENT_KINDS / METRIC_NAMES / SHED_REASONS / DEGRADED_REASONS)
+*from the scanned tree's own AST* — the linter never imports the code it
+checks, so it runs identically on the real tree and on the throwaway
+fixture trees the unit tests build.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+BASELINE_SCHEMA = "drand-tpu.lint-baseline.v1"
+REPORT_SCHEMA = "drand-tpu.lint.v1"
+
+# -- source model --------------------------------------------------------
+
+#: `# drandlint: allow[rule-id] reason` or `allow[rule-a,rule-b] reason`
+_ALLOW_RE = re.compile(
+    r"#\s*drandlint:\s*allow\[([A-Za-z0-9_,\s-]*)\]\s*(.*?)\s*$"
+)
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    path: str          # posix path relative to the lint root
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def key(self) -> Tuple[str, int, str]:
+        return (self.path, self.line, self.rule)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int          # line the suppression *covers*
+    comment_line: int  # line the comment itself is on
+    rules: Tuple[str, ...]
+    reason: str
+
+
+class Source:
+    """One parsed python file plus its inline suppressions."""
+
+    def __init__(self, path: Path, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as exc:
+            self.parse_error = exc
+        self.suppressions: List[Suppression] = self._parse_allows()
+
+    def _parse_allows(self) -> List[Suppression]:
+        out: List[Suppression] = []
+        for i, line in enumerate(self.lines, start=1):
+            m = _ALLOW_RE.search(line)
+            if m is None:
+                continue
+            rules = tuple(
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            )
+            # a comment-only line covers the line below it; a trailing
+            # comment covers its own line
+            covers = i + 1 if line.lstrip().startswith("#") else i
+            out.append(Suppression(line=covers, comment_line=i,
+                                   rules=rules, reason=m.group(2).strip()))
+        return out
+
+    def allow_for(self, rule: str, line: int) -> Optional[Suppression]:
+        for s in self.suppressions:
+            if s.line == line and (rule in s.rules or "*" in s.rules):
+                return s
+        return None
+
+
+# -- AST helpers shared by the rule packs --------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, None for anything fancier."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def first_str_arg(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def kwarg_str(call: ast.Call, name: str) -> Optional[Tuple[str, ast.AST]]:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value, kw.value
+    return None
+
+
+def str_elements(node: ast.AST) -> Iterator[str]:
+    """String constants inside a (frozen)set/tuple/list literal, seeing
+    through a `frozenset({...})` / `tuple((...))` wrapper call."""
+    if isinstance(node, ast.Call) and node.args:
+        fn = dotted(node.func)
+        if fn in ("frozenset", "set", "tuple", "list"):
+            node = node.args[0]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                yield elt.value
+
+
+def imports_jax(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "jax"
+                                or node.module.startswith("jax.")):
+                return True
+    return False
+
+
+# -- configuration -------------------------------------------------------
+
+@dataclasses.dataclass
+class LintConfig:
+    """Path conventions the rule packs encode.  Everything is relative
+    to the lint root so fixture trees in tests get the same treatment as
+    the real repository."""
+
+    #: the package all package-relative conventions anchor to
+    package: str = "drand_tpu"
+    #: the one sanctioned raw-sync file (kernel_span / block live here)
+    sync_allowed: Tuple[str, ...] = ("obs/kernels.py",)
+    #: where `jax.jit` declarations may live (dirs end with /)
+    jit_allowed: Tuple[str, ...] = ("ops/", "parallel/", "crypto/tbls.py")
+    #: kernel-definition land: host/device staging is the point, the
+    #: untimed-sync heuristic does not apply
+    untimed_sync_exempt: Tuple[str, ...] = ("ops/",)
+    #: deterministic-simulation subtree
+    sim_dirs: Tuple[str, ...] = ("sim/",)
+    #: deploy artifacts cross-checked against emitted metrics
+    deploy_files: Tuple[str, ...] = (
+        "deploy/prometheus-alerts.yml",
+        "deploy/grafana-dashboard.json",
+    )
+    #: drand_* tokens in deploy files that are not metric names
+    deploy_token_allowlist: Tuple[str, ...] = ("drand_tpu",)
+
+    def pkg_rel(self, rel: str) -> Optional[str]:
+        """Path relative to the package root, or None if outside it."""
+        prefix = self.package + "/"
+        return rel[len(prefix):] if rel.startswith(prefix) else None
+
+
+# -- project (cross-file state) ------------------------------------------
+
+#: canonical registry constants the drift pack resolves literals against
+_REGISTRY_NAMES = (
+    "EVENT_KINDS", "METRIC_NAMES", "SHED_REASONS", "DEGRADED_REASONS",
+)
+
+
+class Project:
+    def __init__(self, root: Path, config: LintConfig,
+                 sources: List[Source]):
+        self.root = root
+        self.config = config
+        self.sources = sources
+        self._registries: Optional[Dict[str, Set[str]]] = None
+        self._emitted_metrics: Optional[Set[str]] = None
+
+    def registry(self, name: str) -> Set[str]:
+        """String members of a canonical registry constant (for example
+        ``EVENT_KINDS``), collected from plain assignments anywhere in
+        the scanned tree."""
+        if self._registries is None:
+            regs: Dict[str, Set[str]] = {n: set() for n in _REGISTRY_NAMES}
+            for src in self.sources:
+                if src.tree is None:
+                    continue
+                for node in ast.walk(src.tree):
+                    targets: List[ast.AST] = []
+                    if isinstance(node, ast.Assign):
+                        targets, value = node.targets, node.value
+                    elif isinstance(node, ast.AnnAssign) and node.value:
+                        targets, value = [node.target], node.value
+                    else:
+                        continue
+                    for t in targets:
+                        if isinstance(t, ast.Name) and t.id in regs:
+                            regs[t.id].update(str_elements(value))
+            self._registries = regs
+        return self._registries.get(name, set())
+
+    def emitted_metrics(self) -> Set[str]:
+        """Metric names registered anywhere in the tree (literal first
+        args of counter/gauge/histogram calls)."""
+        if self._emitted_metrics is None:
+            out: Set[str] = set()
+            for src in self.sources:
+                if src.tree is None:
+                    continue
+                for node in ast.walk(src.tree):
+                    if isinstance(node, ast.Call):
+                        name = metric_call_name(node)
+                        if name is not None:
+                            out.add(name)
+            self._emitted_metrics = out
+        return self._emitted_metrics
+
+
+def metric_call_name(call: ast.Call) -> Optional[str]:
+    """The literal metric name if `call` registers a metric series."""
+    fn = call.func
+    attr = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None)
+    if attr not in ("counter", "gauge", "histogram"):
+        return None
+    name = first_str_arg(call)
+    if name is not None and name.startswith("drand_"):
+        return name
+    return None
+
+
+# -- rule protocol -------------------------------------------------------
+
+class Rule:
+    id: str = ""
+    pack: str = ""
+    rationale: str = ""
+
+    def check(self, src: Source, project: Project) -> Iterator[Violation]:
+        return iter(())
+
+    def check_project(self, project: Project) -> Iterator[Violation]:
+        """Cross-file rules (the drift pack) override this instead."""
+        for src in project.sources:
+            if src.tree is not None:
+                yield from self.check(src, project)
+
+    def violation(self, src: Source, node: ast.AST,
+                  message: str) -> Violation:
+        return Violation(
+            rule=self.id, path=src.rel,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+class SuppressionRule(Rule):
+    """The suppression syntax itself is checked: an allow with no reason
+    or an unknown rule id is a violation, so the escape hatch cannot rot
+    into an unreviewed ignore list."""
+
+    id = "lint-suppression"
+    pack = "lint"
+    rationale = ("`# drandlint: allow[rule-id] <reason>` must name a real "
+                 "rule and justify itself")
+
+    def check_project(self, project: Project) -> Iterator[Violation]:
+        known = {r.id for r in ALL_RULES} | {"*"}
+        for src in project.sources:
+            for s in src.suppressions:
+                bad: List[str] = []
+                if not s.rules:
+                    bad.append("no rule id")
+                for r in s.rules:
+                    if r not in known:
+                        bad.append(f"unknown rule {r!r}")
+                if not s.reason:
+                    bad.append("missing reason")
+                if bad:
+                    yield Violation(
+                        rule=self.id, path=src.rel, line=s.comment_line,
+                        col=0,
+                        message=("malformed suppression ("
+                                 + "; ".join(bad) + ")"),
+                    )
+
+
+class ParseErrorRule(Rule):
+    id = "lint-parse-error"
+    pack = "lint"
+    rationale = "every linted file must parse"
+
+    def check_project(self, project: Project) -> Iterator[Violation]:
+        for src in project.sources:
+            if src.parse_error is not None:
+                yield Violation(
+                    rule=self.id, path=src.rel,
+                    line=src.parse_error.lineno or 1, col=0,
+                    message=f"syntax error: {src.parse_error.msg}",
+                )
+
+
+# -- running -------------------------------------------------------------
+
+@dataclasses.dataclass
+class Report:
+    root: str
+    violations: List[Violation]
+
+    @property
+    def active(self) -> List[Violation]:
+        return [v for v in self.violations if not v.suppressed]
+
+    @property
+    def suppressed(self) -> List[Violation]:
+        return [v for v in self.violations if v.suppressed]
+
+    def counts(self, suppressed: bool = False) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for v in self.violations:
+            if v.suppressed == suppressed:
+                out[v.rule] = out.get(v.rule, 0) + 1
+        return dict(sorted(out.items()))
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": REPORT_SCHEMA,
+            "root": self.root,
+            "violations": [v.to_dict() for v in self.violations],
+            "counts": self.counts(),
+            "suppressed_counts": self.counts(suppressed=True),
+        }
+
+
+def collect_sources(root: Path, paths: Iterable[Path]) -> List[Source]:
+    files: List[Path] = []
+    for p in paths:
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    out: List[Source] = []
+    seen: Set[Path] = set()
+    for f in files:
+        f = f.resolve()
+        if f in seen or "__pycache__" in f.parts:
+            continue
+        seen.add(f)
+        try:
+            rel = f.relative_to(root).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        out.append(Source(f, rel, f.read_text(encoding="utf-8")))
+    return out
+
+
+def run_lint(root: Path, paths: Optional[Iterable[Path]] = None,
+             config: Optional[LintConfig] = None,
+             rules: Optional[Iterable[Rule]] = None) -> Report:
+    root = root.resolve()
+    config = config or LintConfig()
+    if paths is None:
+        paths = [root / config.package]
+    sources = collect_sources(root, paths)
+    project = Project(root, config, sources)
+    by_rel = {s.rel: s for s in sources}
+    violations: List[Violation] = []
+    for rule in (rules if rules is not None else ALL_RULES):
+        for v in rule.check_project(project):
+            src = by_rel.get(v.path)
+            if src is not None and v.rule != "lint-suppression":
+                sup = src.allow_for(v.rule, v.line)
+                if sup is not None and sup.reason:
+                    v.suppressed = True
+                    v.suppress_reason = sup.reason
+            violations.append(v)
+    violations.sort(key=Violation.key)
+    return Report(root=str(root), violations=violations)
+
+
+# -- baseline ratchet ----------------------------------------------------
+
+def load_baseline(path: Path) -> Dict[str, int]:
+    doc = json.loads(path.read_text())
+    if doc.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(f"unrecognised baseline schema in {path}")
+    return {str(k): int(v) for k, v in doc.get("counts", {}).items()}
+
+
+def write_baseline(path: Path, report: Report) -> None:
+    doc = {"schema": BASELINE_SCHEMA, "counts": report.counts()}
+    path.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+
+
+def compare_baseline(report: Report,
+                     baseline: Dict[str, int]) -> Tuple[bool, List[str]]:
+    """Ratchet: per rule, the unsuppressed count may only decrease.
+    Returns (ok, human-readable messages)."""
+    counts = report.counts()
+    ok = True
+    msgs: List[str] = []
+    for rule in sorted(set(counts) | set(baseline)):
+        cur, base = counts.get(rule, 0), baseline.get(rule, 0)
+        if cur > base:
+            ok = False
+            msgs.append(
+                f"{rule}: {cur} violation(s), baseline allows {base} "
+                f"— fix them (or suppress with a reason)"
+            )
+        elif cur < base:
+            msgs.append(
+                f"{rule}: improved {base} -> {cur}; tighten the ratchet "
+                f"with --write-baseline"
+            )
+    return ok, msgs
+
+
+# -- rendering -----------------------------------------------------------
+
+def render_text(report: Report, verbose_suppressed: bool = False) -> str:
+    lines: List[str] = []
+    for v in report.active:
+        lines.append(f"{v.path}:{v.line}:{v.col}: {v.rule}: {v.message}")
+    if verbose_suppressed:
+        for v in report.suppressed:
+            lines.append(
+                f"{v.path}:{v.line}:{v.col}: {v.rule}: suppressed "
+                f"({v.suppress_reason}): {v.message}"
+            )
+    n_active, n_sup = len(report.active), len(report.suppressed)
+    lines.append(
+        f"drand-lint: {n_active} violation(s), {n_sup} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def rule_catalog() -> List[dict]:
+    return [
+        {"id": r.id, "pack": r.pack, "rationale": r.rationale}
+        for r in ALL_RULES
+    ]
+
+
+# populated at import time by the rule packs (kept at the bottom so the
+# packs can import the helpers above without a cycle)
+from tools.drandlint import (  # noqa: E402
+    rules_asyncio,
+    rules_hotpath,
+    rules_registry,
+    rules_simdet,
+)
+
+ALL_RULES: List[Rule] = [
+    *rules_hotpath.RULES,
+    *rules_simdet.RULES,
+    *rules_asyncio.RULES,
+    *rules_registry.RULES,
+    SuppressionRule(),
+    ParseErrorRule(),
+]

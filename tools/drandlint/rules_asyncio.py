@@ -1,0 +1,235 @@
+"""Asyncio discipline rules (`aio-*`).
+
+The chaos gate has already paid for two of these the hard way (the
+SyncSuperseded TOCTOU and the pinned-link round task were both liveness
+races found at runtime); the cheap half of each class is statically
+visible:
+
+* awaiting something slow while holding an `asyncio.Lock` serialises
+  the protocol behind one peer's RTT (and invites lock-order deadlock);
+* a blocking call on the event loop (sqlite, native BLS, file I/O,
+  `time.sleep`) stalls every handler in the process;
+* `asyncio.create_task(...)` whose result is dropped can be
+  garbage-collected mid-flight (the asyncio docs warn explicitly) and
+  its exception is silently lost — and nothing cancels it on shutdown;
+* a bare `except:` / `except BaseException:` in an `async def` that does
+  not re-raise swallows `CancelledError`, making the task uncancellable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from tools.drandlint.engine import Project, Rule, Source, Violation, dotted
+
+#: receiver-name fragments that mark an awaited call as "slow" (network,
+#: storage, device, scheduled time) for the under-lock rule
+_SLOW_SEGMENTS = frozenset({
+    "net", "client", "transport", "http", "session", "rpc", "sock",
+    "clock", "store", "scheme",
+})
+_SLOW_METHODS = frozenset({
+    "send", "recv", "request", "fetch", "connect", "new_beacon",
+    "send_dkg", "sync_chain", "gather", "wait", "wait_for", "sleep",
+    "to_thread", "run_in_executor",
+})
+
+_BLOCKING_EXACT = frozenset({
+    "time.sleep", "sqlite3.connect", "os.fsync",
+    "socket.create_connection", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+})
+
+_TASK_SPAWNERS = ("asyncio.create_task", "asyncio.ensure_future")
+
+
+def _is_task_spawn(call: ast.Call) -> bool:
+    name = dotted(call.func)
+    if name in _TASK_SPAWNERS:
+        return True
+    # loop.create_task(...) on any *loop-named* receiver
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr == "create_task":
+        recv = dotted(call.func.value) or ""
+        return "loop" in recv.lower()
+    return False
+
+
+def _lockish(expr: ast.AST) -> bool:
+    # locks and mutexes serialise — holding one across a slow await is
+    # the hazard.  Semaphores deliberately bound *concurrent* slow work
+    # (the gossip sender holds one across its RPC by design), so they
+    # are not flagged.
+    name = dotted(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = dotted(expr.func)
+    low = (name or "").lower()
+    return any(s in low for s in ("lock", "mutex"))
+
+
+def _slow_await(value: ast.AST) -> Optional[str]:
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted(value.func)
+    if name is None:
+        return None
+    segments = name.split(".")
+    method = segments[-1]
+    if method in _SLOW_METHODS:
+        return name
+    if any(seg in _SLOW_SEGMENTS for seg in segments[:-1]):
+        return name
+    return None
+
+
+class LockAwaitRule(Rule):
+    id = "aio-lock-await"
+    pack = "asyncio"
+    rationale = ("awaiting network/scheme/store/clock calls while holding "
+                 "an asyncio lock serialises the protocol behind one "
+                 "peer's latency and invites lock-order deadlock")
+
+    def check(self, src: Source, project: Project) -> Iterator[Violation]:
+        yield from self._walk(src, src.tree, holding=None)
+
+    def _walk(self, src: Source, node: ast.AST,
+              holding: Optional[str]) -> Iterator[Violation]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a nested function body runs later, not under this lock
+            for child in ast.iter_child_nodes(node):
+                yield from self._walk(src, child, holding=None)
+            return
+        if isinstance(node, ast.AsyncWith):
+            held = holding
+            for item in node.items:
+                if _lockish(item.context_expr):
+                    held = ast.unparse(item.context_expr)
+            for child in ast.iter_child_nodes(node):
+                yield from self._walk(src, child, held)
+            return
+        if isinstance(node, ast.Await) and holding is not None:
+            slow = _slow_await(node.value)
+            if slow is not None:
+                yield self.violation(
+                    src, node,
+                    f"`await {slow}(...)` while holding `{holding}` — "
+                    f"snapshot under the lock, await outside it",
+                )
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk(src, child, holding)
+
+
+class BlockingCallRule(Rule):
+    id = "aio-blocking-call"
+    pack = "asyncio"
+    rationale = ("blocking work (sqlite, native BLS, subprocess, "
+                 "time.sleep) directly in an `async def` stalls every "
+                 "coroutine in the process — offload via "
+                 "asyncio.to_thread/run_in_executor")
+
+    def check(self, src: Source, project: Project) -> Iterator[Violation]:
+        yield from self._walk(src, src.tree, in_async=False)
+
+    def _walk(self, src: Source, node: ast.AST,
+              in_async: bool) -> Iterator[Violation]:
+        if isinstance(node, ast.AsyncFunctionDef):
+            for child in ast.iter_child_nodes(node):
+                yield from self._walk(src, child, in_async=True)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+            for child in ast.iter_child_nodes(node):
+                yield from self._walk(src, child, in_async=False)
+            return
+        if in_async and isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name is not None:
+                blocking = (
+                    name in _BLOCKING_EXACT
+                    or "native_bls" in name.split(".")
+                )
+                if blocking:
+                    yield self.violation(
+                        src, node,
+                        f"blocking call `{name}` on the event loop — "
+                        f"wrap in asyncio.to_thread/run_in_executor",
+                    )
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk(src, child, in_async)
+
+
+class OrphanTaskRule(Rule):
+    id = "aio-orphan-task"
+    pack = "asyncio"
+    rationale = ("a task whose reference is dropped can be GC'd "
+                 "mid-flight, loses its exception, and is invisible to "
+                 "shutdown — retain it and discard on completion")
+
+    def check(self, src: Source, project: Project) -> Iterator[Violation]:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Expr) \
+                    and isinstance(node.value, ast.Call) \
+                    and _is_task_spawn(node.value):
+                yield self.violation(
+                    src, node.value,
+                    "fire-and-forget task: retain the "
+                    "create_task/ensure_future result (e.g. a task set "
+                    "with a done-callback discard) and cancel it on stop",
+                )
+
+
+class SwallowCancelRule(Rule):
+    id = "aio-swallow-cancel"
+    pack = "asyncio"
+    rationale = ("`except:`/`except BaseException:` in an `async def` "
+                 "without re-raise swallows CancelledError — the task "
+                 "becomes uncancellable and shutdown hangs")
+
+    def check(self, src: Source, project: Project) -> Iterator[Violation]:
+        yield from self._walk(src, src.tree, in_async=False)
+
+    def _walk(self, src: Source, node: ast.AST,
+              in_async: bool) -> Iterator[Violation]:
+        if isinstance(node, ast.AsyncFunctionDef):
+            in_async = True
+        elif isinstance(node, (ast.FunctionDef, ast.Lambda)):
+            in_async = False
+        if in_async and isinstance(node, ast.ExceptHandler):
+            if self._too_broad(node.type) and not self._reraises(node):
+                caught = ("bare except" if node.type is None
+                          else f"except {ast.unparse(node.type)}")
+                yield self.violation(
+                    src, node,
+                    f"`{caught}` in async code without re-raise can "
+                    f"swallow CancelledError — catch `Exception` (plus "
+                    f"`asyncio.CancelledError` explicitly if intended), "
+                    f"or re-raise",
+                )
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk(src, child, in_async)
+
+    @staticmethod
+    def _too_broad(typ: Optional[ast.AST]) -> bool:
+        if typ is None:
+            return True
+        names = [dotted(t) for t in typ.elts] \
+            if isinstance(typ, ast.Tuple) else [dotted(typ)]
+        return any(n is not None and n.split(".")[-1] == "BaseException"
+                   for n in names)
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        def scan(n: ast.AST) -> bool:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return False  # a nested def's raise is not a re-raise
+            if isinstance(n, ast.Raise):
+                return True
+            return any(scan(c) for c in ast.iter_child_nodes(n))
+
+        return any(scan(stmt) for stmt in handler.body)
+
+
+RULES: List[Rule] = [LockAwaitRule(), BlockingCallRule(),
+                     OrphanTaskRule(), SwallowCancelRule()]

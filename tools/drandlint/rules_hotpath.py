@@ -1,0 +1,137 @@
+"""Hot-path purity rules (`hp-*`).
+
+The honest-round budget is <=2 device dispatches with a runtime sentinel
+guarding it (obs/perf.py); these rules catch the *static* half of the
+invariant: a stray host<->device sync or an unsanctioned `jax.jit`
+compiles/syncs on a path the sentinel only notices after it has already
+paged someone.  `obs/kernels.py` is the single sanctioned sync point —
+every device pull elsewhere must run inside its timed `kernel_span`
+context so it is counted, traced and budgeted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from tools.drandlint.engine import (
+    Project,
+    Rule,
+    Source,
+    Violation,
+    dotted,
+    imports_jax,
+)
+
+#: raw sync entry points that bypass the timed wrapper entirely
+_RAW_SYNC_ATTRS = ("block_until_ready", "device_get")
+
+#: `np.asarray(<call>)` spellings that pull a device value to host
+_ASARRAY = ("np.asarray", "numpy.asarray", "onp.asarray")
+
+
+def _in_sync_allowed(rule_src_rel: str, project: Project) -> bool:
+    pkg_rel = project.config.pkg_rel(rule_src_rel)
+    return pkg_rel is not None and pkg_rel in project.config.sync_allowed
+
+
+class RawSyncRule(Rule):
+    id = "hp-sync-call"
+    pack = "hotpath"
+    rationale = ("`block_until_ready`/`device_get` bypass the timed "
+                 "kernel_span sync point; obs/kernels.py is the only "
+                 "file allowed to touch them")
+
+    def check(self, src: Source, project: Project) -> Iterator[Violation]:
+        if _in_sync_allowed(src.rel, project):
+            return
+        if project.config.pkg_rel(src.rel) is None:
+            return
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _RAW_SYNC_ATTRS:
+                yield self.violation(
+                    src, node,
+                    f"raw device sync `{dotted(node) or node.attr}` — "
+                    f"route it through obs/kernels.py "
+                    f"(kernel_span / kernels.block)",
+                )
+
+
+class UntimedSyncRule(Rule):
+    """`np.asarray(f(...))` / `float(f(...))` on a jax value forces the
+    device to finish — outside a `with kernel_span(...)` block that wait
+    is invisible to the dispatch budget and the kernel baselines."""
+
+    id = "hp-untimed-sync"
+    pack = "hotpath"
+    rationale = ("host pulls of device values must happen inside "
+                 "`with kernel_span(...)` so they are timed and counted")
+
+    def check(self, src: Source, project: Project) -> Iterator[Violation]:
+        cfg = project.config
+        pkg_rel = cfg.pkg_rel(src.rel)
+        if pkg_rel is None or pkg_rel in cfg.sync_allowed:
+            return
+        if any(pkg_rel.startswith(d) for d in cfg.untimed_sync_exempt):
+            return
+        if not imports_jax(src.tree):
+            return
+        yield from self._walk(src, src.tree, in_span=False)
+
+    def _walk(self, src: Source, node: ast.AST,
+              in_span: bool) -> Iterator[Violation]:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            entered = in_span or any(
+                isinstance(item.context_expr, ast.Call)
+                and (dotted(item.context_expr.func) or "").endswith(
+                    "kernel_span")
+                for item in node.items
+            )
+            for child in ast.iter_child_nodes(node):
+                yield from self._walk(src, child, entered)
+            return
+        if isinstance(node, ast.Call) and not in_span:
+            name = dotted(node.func)
+            pulls = (
+                name in _ASARRAY
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id == "float")
+            )
+            if pulls and node.args \
+                    and isinstance(node.args[0], ast.Call):
+                yield self.violation(
+                    src, node,
+                    f"`{name or 'float'}(<call>)` pulls a device value "
+                    f"to host outside `with kernel_span(...)` — the sync "
+                    f"is untimed and uncounted",
+                )
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk(src, child, in_span)
+
+
+class JitScopeRule(Rule):
+    id = "hp-jit-scope"
+    pack = "hotpath"
+    rationale = ("`jax.jit` only in ops/, parallel/ and crypto/tbls.py — "
+                 "a jit declared elsewhere is a new compile surface the "
+                 "recompile-storm detector and warmup path don't know")
+
+    def check(self, src: Source, project: Project) -> Iterator[Violation]:
+        cfg = project.config
+        pkg_rel = cfg.pkg_rel(src.rel)
+        if pkg_rel is None:
+            return
+        if any(pkg_rel.startswith(d) if d.endswith("/") else pkg_rel == d
+               for d in cfg.jit_allowed):
+            return
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Attribute) and dotted(node) == "jax.jit":
+                yield self.violation(
+                    src, node,
+                    "`jax.jit` outside the kernel layers (ops/, "
+                    "parallel/, crypto/tbls.py)",
+                )
+
+
+RULES: List[Rule] = [RawSyncRule(), UntimedSyncRule(), JitScopeRule()]

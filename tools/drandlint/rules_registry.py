@@ -1,0 +1,262 @@
+"""Registry-drift rules (`reg-*`).
+
+Observability names are string-coupled across layers: a flight-event
+kind recorded in beacon/handler.py is grepped for by `cli doctor`, a
+metric name registered in obs/watch.py is regex-matched by
+deploy/prometheus-alerts.yml (PR 11's `DrandDeepReorg` depth-regex alert
+is exactly this), a shed reason recorded by the gateway is a label the
+grafana dashboard pivots on.  None of that coupling is visible to the
+interpreter — a rename silently breaks the alert, not the test suite.
+
+These rules resolve every such literal against a canonical registry
+constant in the owning module:
+
+* flight-event kinds   -> ``EVENT_KINDS``      (drand_tpu/obs/flight.py)
+* metric names         -> ``METRIC_NAMES``     (drand_tpu/utils/metrics.py)
+* gateway shed reasons -> ``SHED_REASONS``     (drand_tpu/serve/gateway.py)
+* degraded_reason      -> ``DEGRADED_REASONS`` (drand_tpu/obs/perf.py)
+
+and cross-check the deploy artifacts against the metrics the code
+actually registers.  The registries are extracted from the scanned
+tree's AST, never imported — fixture trees in tests define their own.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Set
+
+from tools.drandlint.engine import (
+    Project,
+    Rule,
+    Source,
+    Violation,
+    dotted,
+    first_str_arg,
+    metric_call_name,
+)
+
+#: call spellings that record a flight event with a literal kind
+_RECORD_ATTRS = ("record", "_event")
+
+_METRIC_TOKEN_RE = re.compile(r"\bdrand_[a-z0-9_]+\b")
+_HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _record_kind(call: ast.Call) -> Optional[str]:
+    """Literal event kind if `call` looks like a flight-event record."""
+    fn = call.func
+    attr = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None)
+    if attr not in _RECORD_ATTRS:
+        return None
+    return first_str_arg(call)
+
+
+class FlightEventRule(Rule):
+    id = "reg-flight-event"
+    pack = "registry"
+    rationale = ("every flight-event kind must be declared in "
+                 "obs/flight.py EVENT_KINDS — doctor, `cli trace` and "
+                 "the sim lens dispatch on these strings")
+
+    def check_project(self, project: Project) -> Iterator[Violation]:
+        kinds = project.registry("EVENT_KINDS")
+        for src in project.sources:
+            if src.tree is None or \
+                    project.config.pkg_rel(src.rel) is None:
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _record_kind(node)
+                if kind is not None and kind not in kinds:
+                    yield self.violation(
+                        src, node,
+                        f"flight event kind {kind!r} is not in "
+                        f"EVENT_KINDS (obs/flight.py) — register it or "
+                        f"fix the typo",
+                    )
+
+
+class MetricNameRule(Rule):
+    id = "reg-metric-name"
+    pack = "registry"
+    rationale = ("every drand_* metric name must be declared in "
+                 "utils/metrics.py METRIC_NAMES — alerts and dashboards "
+                 "match on the exact string")
+
+    def check_project(self, project: Project) -> Iterator[Violation]:
+        names = project.registry("METRIC_NAMES")
+        for src in project.sources:
+            if src.tree is None or \
+                    project.config.pkg_rel(src.rel) is None:
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = metric_call_name(node)
+                if name is not None and name not in names:
+                    yield self.violation(
+                        src, node,
+                        f"metric {name!r} is not in METRIC_NAMES "
+                        f"(utils/metrics.py) — register it or fix the "
+                        f"typo",
+                    )
+
+
+class ShedReasonRule(Rule):
+    id = "reg-shed-reason"
+    pack = "registry"
+    rationale = ("gateway shed reasons are a closed vocabulary "
+                 "(SHED_REASONS in serve/gateway.py); dashboards and the "
+                 "fleet aggregator pivot on the label value")
+
+    def check_project(self, project: Project) -> Iterator[Violation]:
+        reasons = project.registry("SHED_REASONS")
+        for src in project.sources:
+            if src.tree is None or \
+                    project.config.pkg_rel(src.rel) is None:
+                continue
+            for node in ast.walk(src.tree):
+                lit: Optional[str] = None
+                where: Optional[ast.AST] = None
+                if isinstance(node, ast.Call) \
+                        and _record_kind(node) == "shed":
+                    for kw in node.keywords:
+                        if kw.arg == "reason" \
+                                and isinstance(kw.value, ast.Constant) \
+                                and isinstance(kw.value.value, str):
+                            lit, where = kw.value.value, kw.value
+                elif isinstance(node, ast.Subscript):
+                    recv = dotted(node.value) or ""
+                    if recv.split(".")[-1] == "_shed" \
+                            and isinstance(node.slice, ast.Constant) \
+                            and isinstance(node.slice.value, str):
+                        lit, where = node.slice.value, node
+                if lit is not None and lit not in reasons:
+                    yield self.violation(
+                        src, where,
+                        f"shed reason {lit!r} is not in SHED_REASONS "
+                        f"(serve/gateway.py)",
+                    )
+
+
+class DegradedReasonRule(Rule):
+    id = "reg-degraded-reason"
+    pack = "registry"
+    rationale = ("`degraded_reason` is a closed infra|code vocabulary "
+                 "(DEGRADED_REASONS in obs/perf.py) validated at "
+                 "artifact construction; a third value would silently "
+                 "pass the bench lineage checks")
+
+    def check_project(self, project: Project) -> Iterator[Violation]:
+        vocab = project.registry("DEGRADED_REASONS")
+        for src in project.sources:
+            if src.tree is None or \
+                    project.config.pkg_rel(src.rel) is None:
+                continue
+            for node in ast.walk(src.tree):
+                for lit, where in self._literals(node):
+                    if lit not in vocab:
+                        yield self.violation(
+                            src, where,
+                            f"degraded_reason {lit!r} is outside "
+                            f"DEGRADED_REASONS (obs/perf.py)",
+                        )
+
+    @staticmethod
+    def _names_degraded(expr: ast.AST) -> bool:
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            d = dotted(expr)
+            return d is not None and \
+                d.split(".")[-1] == "degraded_reason"
+        if isinstance(expr, ast.Subscript) \
+                and isinstance(expr.slice, ast.Constant):
+            return expr.slice.value == "degraded_reason"
+        if isinstance(expr, ast.Call):
+            # d.get("degraded_reason")
+            fn = expr.func
+            return isinstance(fn, ast.Attribute) and fn.attr == "get" \
+                and first_str_arg(expr) == "degraded_reason"
+        return False
+
+    def _literals(self, node: ast.AST):
+        """(literal, node) pairs where a string is bound to / compared
+        with degraded_reason.  `None` is always allowed (not a string)."""
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "degraded_reason" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    yield kw.value.value, kw.value
+        elif isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if any(self._names_degraded(s) for s in sides):
+                for s in sides:
+                    if isinstance(s, ast.Constant) \
+                            and isinstance(s.value, str):
+                        yield s.value, s
+                    elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                        for elt in s.elts:
+                            if isinstance(elt, ast.Constant) \
+                                    and isinstance(elt.value, str):
+                                yield elt.value, elt
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) \
+                        and k.value == "degraded_reason" \
+                        and isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str):
+                    yield v.value, v
+        elif isinstance(node, ast.Assign):
+            if any(self._names_degraded(t) for t in node.targets) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                yield node.value.value, node.value
+
+
+class DeployMetricRule(Rule):
+    id = "reg-deploy-metric"
+    pack = "registry"
+    rationale = ("deploy/prometheus-alerts.yml and "
+                 "deploy/grafana-dashboard.json must reference only "
+                 "metrics the code registers — a rename otherwise rots "
+                 "the alert silently")
+
+    def check_project(self, project: Project) -> Iterator[Violation]:
+        emitted = project.emitted_metrics()
+        if not emitted:
+            return  # tree registers no metrics: nothing to cross-check
+        allow = set(project.config.deploy_token_allowlist)
+        for rel in project.config.deploy_files:
+            path = project.root / rel
+            if not path.exists():
+                continue
+            text = path.read_text(encoding="utf-8")
+            seen: Set[str] = set()
+            for i, line in enumerate(text.splitlines(), start=1):
+                for tok in _METRIC_TOKEN_RE.findall(line):
+                    if tok in seen or tok in allow:
+                        continue
+                    seen.add(tok)
+                    if not self._resolves(tok, emitted):
+                        yield Violation(
+                            rule=self.id, path=rel, line=i, col=0,
+                            message=(f"{tok!r} does not match any metric "
+                                     f"registered in the code"),
+                        )
+
+    @staticmethod
+    def _resolves(token: str, emitted: Set[str]) -> bool:
+        if token in emitted:
+            return True
+        for suf in _HISTO_SUFFIXES:
+            if token.endswith(suf) and token[: -len(suf)] in emitted:
+                return True
+        return False
+
+
+RULES: List[Rule] = [FlightEventRule(), MetricNameRule(), ShedReasonRule(),
+                     DegradedReasonRule(), DeployMetricRule()]

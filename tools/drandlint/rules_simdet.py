@@ -1,0 +1,93 @@
+"""Sim-determinism rules (`sim-*`).
+
+`drand_tpu/sim/` promises byte-identical seeded replay, cross-process and
+cross-PYTHONHASHSEED (the committed fork_stall watch fixture depends on
+it).  One wall-clock read or one draw from ambient entropy silently
+breaks that promise in a way only the nightly fuzz sweep would catch —
+so inside the sim subtree, time comes from the FakeClock and randomness
+from the fabric's string-seeded `random.Random` streams, full stop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from tools.drandlint.engine import Project, Rule, Source, Violation, dotted
+
+_WALLCLOCK = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.sleep",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "date.today", "datetime.date.today",
+})
+
+#: module-level `random.*` draws share one ambient stream; seeded
+#: `random.Random(...)` instances are the sanctioned replacement
+_ENTROPY_EXACT = frozenset({
+    "os.urandom", "uuid.uuid4", "uuid.uuid1",
+    "random.random", "random.randint", "random.randrange",
+    "random.choice", "random.choices", "random.shuffle", "random.sample",
+    "random.uniform", "random.gauss", "random.seed",
+    "random.getrandbits", "random.randbytes", "random.expovariate",
+    "random.betavariate", "random.triangular", "random.normalvariate",
+})
+
+_ENTROPY_PREFIXES = ("secrets.", "np.random.", "numpy.random.",
+                     "jax.random.")
+
+
+def _in_sim(src: Source, project: Project) -> bool:
+    pkg_rel = project.config.pkg_rel(src.rel)
+    return pkg_rel is not None and any(
+        pkg_rel.startswith(d) for d in project.config.sim_dirs
+    )
+
+
+class SimWallClockRule(Rule):
+    id = "sim-wallclock"
+    pack = "simdet"
+    rationale = ("sim code reads time from the schedulable FakeClock; a "
+                 "wall-clock read makes seeded replay diverge")
+
+    def check(self, src: Source, project: Project) -> Iterator[Violation]:
+        if not _in_sim(src, project):
+            return
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name in _WALLCLOCK:
+                    yield self.violation(
+                        src, node,
+                        f"wall-clock call `{name}` in sim code — use the "
+                        f"FakeClock (clock.now()/clock.sleep())",
+                    )
+
+
+class SimEntropyRule(Rule):
+    id = "sim-entropy"
+    pack = "simdet"
+    rationale = ("sim randomness comes from string-seeded random.Random "
+                 "streams (PYTHONHASHSEED-proof); ambient entropy breaks "
+                 "byte-identical replay")
+
+    def check(self, src: Source, project: Project) -> Iterator[Violation]:
+        if not _in_sim(src, project):
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            if name in _ENTROPY_EXACT or \
+                    any(name.startswith(p) for p in _ENTROPY_PREFIXES):
+                yield self.violation(
+                    src, node,
+                    f"ambient entropy `{name}` in sim code — draw from a "
+                    f"string-seeded random.Random stream instead",
+                )
+
+
+RULES: List[Rule] = [SimWallClockRule(), SimEntropyRule()]

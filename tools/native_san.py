@@ -1,0 +1,78 @@
+"""Run the native-backend test suites under ASan+UBSan.
+
+The C++ backends (native/bls.cc, native/chainstore.cc) are normally
+built -O2 and loaded via ctypes; memory corruption there shows up as a
+flaky segfault three tests later, not a diagnosable failure.  This
+runner rebuilds them with -fsanitize=address,undefined (see
+drand_tpu.native.sanitize_enabled) and re-runs the native suites with
+the environment the sanitizer runtime needs:
+
+* LD_PRELOAD=libasan.so — the python binary is not instrumented, so the
+  ASan runtime must be the first DSO in the process or dlopen of the
+  instrumented .so aborts with "ASan runtime does not come first";
+* ASAN_OPTIONS=detect_leaks=0 — leak checking an uninstrumented CPython
+  drowns real findings in interpreter-lifetime allocations;
+* UBSAN_OPTIONS=print_stacktrace=1 plus -fno-sanitize-recover at build
+  time: any UB finding aborts the run.
+
+Usage: python tools/native_san.py [pytest args...]
+(defaults to the native suites; exit code is pytest's, or 3 when no
+usable libasan/g++ exists — CI treats that as a hard failure, local
+dev machines without gcc just report it.)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+NATIVE_SUITES = ["tests/test_native_bls.py", "tests/test_native_store.py"]
+
+
+def find_libasan(cxx: str = "g++") -> str | None:
+    """Ask the compiler driver where its ASan runtime lives."""
+    try:
+        out = subprocess.run(
+            [cxx, "-print-file-name=libasan.so"],
+            capture_output=True, text=True, timeout=30,
+        )
+    except OSError:
+        return None
+    path = out.stdout.strip()
+    # an unknown file echoes back unchanged ("libasan.so", no directory)
+    if out.returncode == 0 and os.path.sep in path \
+            and os.path.exists(path):
+        return path
+    return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    cxx = os.environ.get("CXX", "g++")
+    libasan = find_libasan(cxx)
+    if libasan is None:
+        print(f"native-san: no usable libasan via {cxx} "
+              f"(-print-file-name=libasan.so)", file=sys.stderr)
+        return 3
+
+    env = dict(os.environ)
+    env["DRAND_NATIVE_SAN"] = "1"
+    env["LD_PRELOAD"] = ":".join(
+        p for p in (libasan, env.get("LD_PRELOAD")) if p
+    )
+    env.setdefault("ASAN_OPTIONS", "detect_leaks=0:abort_on_error=1")
+    env.setdefault("UBSAN_OPTIONS", "print_stacktrace=1")
+    # the native suites don't touch jax, but transitive imports might —
+    # keep them off any accelerator so the run is pure host memory
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    cmd = [sys.executable, "-m", "pytest", "-q",
+           *(args or NATIVE_SUITES)]
+    print(f"native-san: LD_PRELOAD={libasan}")
+    print(f"native-san: {' '.join(cmd)}")
+    return subprocess.run(cmd, env=env).returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
